@@ -1,0 +1,75 @@
+"""CompileData / CompileStats / CacheEntry for the jit driver.
+
+Counterpart of reference thunder/common.py:65-180 and thunder/__init__.py:258.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+
+class CompileStats:
+    """Per-compile timings and cache counters (reference thunder/common.py:65)."""
+
+    def __init__(self):
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.calls = 0
+        self.last_trace_tracing_time_ns = 0
+        self.last_trace_transform_time_ns = 0
+        self.last_compile_time_ns = 0
+        self.last_traces: list = []
+        self.last_backward_traces: list = []
+        self.last_prologue_traces: list = []
+
+
+class CompileData:
+    """Per-compile configuration (reference thunder/common.py:180)."""
+
+    def __init__(
+        self,
+        *,
+        fn: Callable,
+        executors: Sequence = (),
+        cache_option: str = "constant values",
+        transforms: Sequence = (),
+        disable_fusion: bool = False,
+        compile_options: dict | None = None,
+    ):
+        self.fn = fn
+        self.executors = tuple(executors)
+        self.cache_option = cache_option
+        self.transforms = list(transforms)
+        self.disable_fusion = disable_fusion
+        self.compile_options = dict(compile_options or {})
+        self.is_module = False
+        self.module = None
+        # distributed state set by parallel transforms
+        self.mesh = None
+        self.process_group = None
+        self.use_fsdp = False
+        self.use_ddp = False
+
+    def get_compile_option(self, name: str, default=None):
+        return self.compile_options.get(name, default)
+
+
+class CacheEntry:
+    """One compiled specialization (reference thunder/__init__.py:258)."""
+
+    __slots__ = (
+        "prologue_fn",
+        "computation_fn",
+        "backward_fn",
+        "prologue_trc",
+        "computation_trc",
+        "backward_trc",
+        "treedef",
+        "tensor_mask",
+        "static_leaves",
+        "key",
+    )
+
+    def __init__(self, **kw):
+        for s in self.__slots__:
+            setattr(self, s, kw.get(s))

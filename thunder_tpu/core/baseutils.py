@@ -1,0 +1,73 @@
+"""Shared low-level utilities.
+
+TPU-native re-design of the helper layer the reference keeps in
+``thunder/core/baseutils.py`` (see reference thunder/core/baseutils.py:1).
+"""
+from __future__ import annotations
+
+from collections.abc import Sequence
+from numbers import Number
+from typing import Any
+
+
+class ThunderTPUError(RuntimeError):
+    pass
+
+
+def check(pred: bool, msg, exc_type=RuntimeError) -> None:
+    """Lazy-message assertion helper (reference thunder/core/baseutils.py:103)."""
+    if not pred:
+        raise exc_type(msg() if callable(msg) else str(msg))
+
+
+def check_type(x: Any, types, name: str = "value") -> None:
+    if not isinstance(x, types):
+        raise TypeError(f"{name} expected {types}, got {type(x)}: {x!r}")
+
+
+def is_collection(x: Any) -> bool:
+    return isinstance(x, (tuple, list, dict, set))
+
+
+def sequencify(x: Any) -> Sequence:
+    if isinstance(x, (tuple, list)):
+        return x
+    return (x,)
+
+
+_number_types = (int, float, bool, complex)
+
+
+def is_number(x: Any) -> bool:
+    return isinstance(x, Number) or isinstance(x, _number_types)
+
+
+def canonicalize_dim(rank: int, dim: int, wrap_scalar: bool = True) -> int:
+    """Wrap a possibly-negative dimension index (reference thunder/core/baseutils.py logic)."""
+    if rank < 0:
+        raise ValueError(f"rank must be >= 0, got {rank}")
+    if rank == 0 and wrap_scalar:
+        rank = 1
+    if dim < -rank or dim >= rank:
+        raise IndexError(f"dim {dim} out of range for rank {rank}")
+    if dim < 0:
+        dim += rank
+    return dim
+
+
+def canonicalize_dims(rank: int, dims, wrap_scalar: bool = True):
+    if isinstance(dims, (tuple, list)):
+        return tuple(canonicalize_dim(rank, d, wrap_scalar) for d in dims)
+    return canonicalize_dim(rank, dims, wrap_scalar)
+
+
+class ProxyInterface:
+    """Marker base so modules can test proxy-ness without importing proxies."""
+
+
+class SymbolInterface:
+    pass
+
+
+class TraceInterface:
+    pass

@@ -1,0 +1,94 @@
+"""Printing trace arguments as executable Python source.
+
+Counterpart of reference thunder/core/codeutils.py:1-509 (SigInfo + printable
+objects). Values that have no faithful literal repr (dtypes, devices, jax
+arrays, callables) are interned into the compilation context dict and printed
+as a name."""
+from __future__ import annotations
+
+from numbers import Number
+from typing import Any
+
+from . import dtypes, devices
+from .proxies import Proxy, NumberProxy, CollectionProxy
+
+
+class ContextInterner:
+    """Assigns stable names to out-of-line constants used by generated code."""
+
+    def __init__(self):
+        self.ctx: dict[str, Any] = {}
+        self._counter = 0
+
+    def intern(self, obj: Any, hint: str = "c") -> str:
+        for k, v in self.ctx.items():
+            if v is obj:
+                return k
+        self._counter += 1
+        name = f"_{hint}{self._counter}"
+        self.ctx[name] = obj
+        return name
+
+
+def prettyprint(x: Any, interner: ContextInterner) -> str:
+    """Render x as a python expression valid inside the generated function."""
+    if isinstance(x, NumberProxy):
+        # static numbers print as literals; keeps generated code jit-friendly
+        if x.is_static:
+            return repr(x.value)
+        return x.name
+    if isinstance(x, CollectionProxy):
+        return prettyprint(x.coll, interner)
+    if isinstance(x, Proxy):
+        return x.name
+    if x is None or isinstance(x, (bool, int, str)):
+        return repr(x)
+    if isinstance(x, float):
+        return repr(x) if x == x and abs(x) != float("inf") else f"float('{x}')"
+    if isinstance(x, complex):
+        return repr(x)
+    if isinstance(x, slice):
+        return f"slice({prettyprint(x.start, interner)}, {prettyprint(x.stop, interner)}, {prettyprint(x.step, interner)})"
+    if isinstance(x, tuple):
+        inner = ", ".join(prettyprint(e, interner) for e in x)
+        return f"({inner},)" if len(x) == 1 else f"({inner})"
+    if isinstance(x, list):
+        return "[" + ", ".join(prettyprint(e, interner) for e in x) + "]"
+    if isinstance(x, dict):
+        return "{" + ", ".join(f"{prettyprint(k, interner)}: {prettyprint(v, interner)}" for k, v in x.items()) + "}"
+    if isinstance(x, dtypes.dtype):
+        return interner.intern(x, "dtype_")
+    if isinstance(x, devices.Device):
+        return interner.intern(x, "dev_")
+    if isinstance(x, type) and x in (bool, int, float, complex):
+        return x.__name__
+    # everything else (jax arrays, enums, callables, meshes): intern
+    return interner.intern(x, "obj")
+
+
+def flat_proxies(x: Any) -> list[Proxy]:
+    """All proxies contained in a (possibly nested) value, in deterministic order."""
+    out: list[Proxy] = []
+
+    def rec(v):
+        if isinstance(v, CollectionProxy):
+            rec(v.coll)
+        elif isinstance(v, Proxy):
+            out.append(v)
+        elif isinstance(v, (tuple, list)):
+            for e in v:
+                rec(e)
+        elif isinstance(v, dict):
+            for e in v.values():
+                rec(e)
+        elif isinstance(v, slice):
+            rec(v.start), rec(v.stop), rec(v.step)
+
+    rec(x)
+    return out
+
+
+def flat_tensor_proxies(x: Any) -> list:
+    from .proxies import TensorProxy
+
+    return [p for p in flat_proxies(x) if isinstance(p, TensorProxy)]

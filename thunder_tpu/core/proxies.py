@@ -1,0 +1,482 @@
+"""Proxies: abstract values recorded into traces.
+
+Re-design of reference thunder/core/proxies.py:94-2129. The proxy zoo is the
+same in spirit — TensorProxy (shape/dtype/device/requires_grad and a
+distributed-parallel annotation), NumberProxy, CollectionProxy,
+FutureTensorProxy for async collectives — but TPU-native: the sharding
+annotation is a named-axis spec aimed at ``jax.sharding`` rather than a
+torch DTensor placement, and runtime values are jax Arrays.
+
+Method/operator dispatch on TensorProxy resolves through a method registry the
+op namespaces populate at import time (the reference routes this through
+language contexts, thunder/core/langctxs.py:1-146).
+"""
+from __future__ import annotations
+
+from enum import Enum
+from numbers import Number
+from typing import Any, Callable, Optional, Sequence
+
+from . import baseutils, dtypes, devices
+from .baseutils import ProxyInterface, check
+
+
+class DistParallelType(Enum):
+    """Mirrors reference thunder/core/proxies.py:1218-1224, extended with
+    TPU-relevant sequence/expert parallel kinds."""
+
+    NONE = "none"
+    REPLICATED = "replicated"
+    FULLY_SHARDED = "fully_sharded"
+    COLUMN_WISE = "column_wise"
+    ROW_WISE = "row_wise"
+    SEQUENCE_SHARDED = "sequence_sharded"
+    EXPERT_SHARDED = "expert_sharded"
+
+
+# ---------------------------------------------------------------------------
+# method registry (populated by thunder_tpu.ops at import time)
+# ---------------------------------------------------------------------------
+
+_tensor_methods: dict[str, Callable] = {}
+
+
+def register_method(name: str, fn: Callable) -> None:
+    _tensor_methods[name] = fn
+
+
+def get_method(name: str) -> Callable:
+    fn = _tensor_methods.get(name)
+    if fn is None:
+        raise AttributeError(
+            f"TensorProxy method '{name}' is not registered; import thunder_tpu.ops first"
+        )
+    return fn
+
+
+# ---------------------------------------------------------------------------
+
+
+def _make_name(prefix: str, name: str | None) -> str:
+    from .trace import get_tracectx
+
+    trc = get_tracectx()
+    if name is not None:
+        if trc is not None:
+            trc.add_name(name)
+        return name
+    if trc is not None:
+        return trc.make_name(prefix)
+    global _anon_counter
+    _anon_counter += 1
+    return f"{prefix}{_anon_counter}_anon"
+
+
+_anon_counter = 0
+
+
+class Proxy(ProxyInterface):
+    _prefix = "p"
+
+    def __init__(self, name: str | None = None):
+        self.name = _make_name(self._prefix, name)
+
+    def replace_name(self, name: str) -> "Proxy":
+        import copy
+
+        p = copy.copy(self)
+        p.name = name
+        return p
+
+    def type_string(self) -> str:
+        return "Any"
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class Variable:
+    """Hashable identity wrapper for proxies (reference thunder/core/proxies.py:60 variableify)."""
+
+    __slots__ = ("proxy",)
+
+    def __init__(self, proxy: Proxy):
+        self.proxy = proxy
+
+    def __hash__(self) -> int:
+        return hash(self.proxy.name)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Variable) and other.proxy.name == self.proxy.name
+
+    def __repr__(self) -> str:
+        return f"Var({self.proxy.name})"
+
+
+def variableify(x):
+    if isinstance(x, Proxy):
+        return Variable(x)
+    return x
+
+
+def unvariableify(x):
+    if isinstance(x, Variable):
+        return x.proxy
+    return x
+
+
+class NumberProxy(Proxy):
+    """A (possibly statically-known) python number in a trace.
+
+    Reference: thunder/core/proxies.py:668. On TPU static shapes are strongly
+    preferred, so NumberProxies default to being compile-time constants
+    (constraint STATIC); symbolic-value caching can relax this later.
+    """
+
+    _prefix = "n"
+
+    def __init__(self, value: Number | None, python_type: type = None, name: str | None = None):
+        super().__init__(name)
+        self.value = value
+        self.python_type = python_type or (type(value) if value is not None else float)
+
+    @property
+    def is_static(self) -> bool:
+        return self.value is not None
+
+    def type_string(self) -> str:
+        return f"{self.python_type.__name__} {self.value}"
+
+    # numbers behave statically in traces
+    def __bool__(self):
+        check(self.value is not None, lambda: "cannot branch on a dynamic NumberProxy")
+        return bool(self.value)
+
+    def __int__(self):
+        return int(self.value)
+
+    def __float__(self):
+        return float(self.value)
+
+    def __index__(self):
+        return int(self.value)
+
+    def _num_binop(self, other, op, rop=False):
+        ov = other.value if isinstance(other, NumberProxy) else other
+        if self.value is None or ov is None:
+            raise NotImplementedError("symbolic number arithmetic not yet supported")
+        return op(ov, self.value) if rop else op(self.value, ov)
+
+    def __add__(self, o):
+        return self._num_binop(o, lambda a, b: a + b)
+
+    def __radd__(self, o):
+        return self._num_binop(o, lambda a, b: a + b, rop=True)
+
+    def __sub__(self, o):
+        return self._num_binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._num_binop(o, lambda a, b: a - b, rop=True)
+
+    def __mul__(self, o):
+        return self._num_binop(o, lambda a, b: a * b)
+
+    def __rmul__(self, o):
+        return self._num_binop(o, lambda a, b: a * b, rop=True)
+
+    def __truediv__(self, o):
+        return self._num_binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._num_binop(o, lambda a, b: a / b, rop=True)
+
+    def __floordiv__(self, o):
+        return self._num_binop(o, lambda a, b: a // b)
+
+    def __mod__(self, o):
+        return self._num_binop(o, lambda a, b: a % b)
+
+    def __neg__(self):
+        return -self.value
+
+    def __eq__(self, o):
+        return self.value == (o.value if isinstance(o, NumberProxy) else o)
+
+    def __ne__(self, o):
+        return not self.__eq__(o)
+
+    def __lt__(self, o):
+        return self._num_binop(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._num_binop(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._num_binop(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._num_binop(o, lambda a, b: a >= b)
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+def pyval(x):
+    """Static python value of a number-or-NumberProxy."""
+    if isinstance(x, NumberProxy):
+        return x.value
+    return x
+
+
+class StringProxy(Proxy):
+    _prefix = "s"
+
+    def __init__(self, value: str, name: str | None = None):
+        super().__init__(name)
+        self.value = value
+
+
+class CollectionProxy(Proxy):
+    """Names a static python collection inside a trace (reference proxies.py CollectionProxy)."""
+
+    _prefix = "C"
+
+    def __init__(self, coll, name: str | None = None):
+        super().__init__(name)
+        self.coll = coll
+
+
+class AnyProxy(Proxy):
+    _prefix = "a"
+
+    def __init__(self, value: Any = None, name: str | None = None):
+        super().__init__(name)
+        self.value = value
+
+
+class TensorProxy(Proxy):
+    """The core abstract tensor.
+
+    Carries shape / dtype / device / requires_grad plus distributed metadata:
+    ``distparallel_type`` (which parallel transform owns this tensor) and
+    ``sharding`` — a tuple of mesh-axis names (or None) per dimension, the
+    TPU-native analog of the reference's ``thunder_fsdp_padding_size`` +
+    DTensor placements (reference thunder/core/proxies.py:1442).
+    """
+
+    _prefix = "t"
+
+    def __init__(
+        self,
+        name: str | None = None,
+        *,
+        shape: Sequence[int],
+        dtype: dtypes.dtype,
+        device: devices.Device | None = None,
+        requires_grad: bool = False,
+        distparallel_type: DistParallelType = DistParallelType.NONE,
+        sharding: Optional[tuple] = None,
+        fsdp_padding: int = 0,
+        tags: frozenset = frozenset(),
+    ):
+        super().__init__(name)
+        self.shape = tuple(int(pyval(s)) for s in shape)
+        self.dtype = dtypes.to_dtype(dtype)
+        self.device = device if device is not None else devices.default_device()
+        self.requires_grad = requires_grad
+        self.distparallel_type = distparallel_type
+        self.sharding = sharding
+        self.fsdp_padding = fsdp_padding
+        self.tags = tags
+
+    # --- metadata ---
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def numel(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def size(self, dim: int | None = None):
+        if dim is None:
+            return self.shape
+        return self.shape[dim]
+
+    def dim(self) -> int:
+        return self.ndim
+
+    def numel_(self) -> int:
+        return self.numel
+
+    def type_string(self) -> str:
+        return f'{self.device} {self.dtype.shortname}{list(self.shape)}'
+
+    def replace(self, **changes) -> "TensorProxy":
+        kwargs = dict(
+            shape=self.shape,
+            dtype=self.dtype,
+            device=self.device,
+            requires_grad=self.requires_grad,
+            distparallel_type=self.distparallel_type,
+            sharding=self.sharding,
+            fsdp_padding=self.fsdp_padding,
+            tags=self.tags,
+        )
+        name = changes.pop("name", None)
+        kwargs.update(changes)
+        return TensorProxy(name, **kwargs)
+
+    def __repr__(self) -> str:
+        return f'<TensorProxy {self.name}: {self.type_string()}>'
+
+    # --- operator overloads dispatch through the method registry ---
+    def __add__(self, o):
+        return get_method("add")(self, o)
+
+    def __radd__(self, o):
+        return get_method("add")(o, self)
+
+    def __sub__(self, o):
+        return get_method("sub")(self, o)
+
+    def __rsub__(self, o):
+        return get_method("sub")(o, self)
+
+    def __mul__(self, o):
+        return get_method("mul")(self, o)
+
+    def __rmul__(self, o):
+        return get_method("mul")(o, self)
+
+    def __truediv__(self, o):
+        return get_method("true_divide")(self, o)
+
+    def __rtruediv__(self, o):
+        return get_method("true_divide")(o, self)
+
+    def __floordiv__(self, o):
+        return get_method("floor_divide")(self, o)
+
+    def __pow__(self, o):
+        return get_method("pow")(self, o)
+
+    def __rpow__(self, o):
+        return get_method("pow")(o, self)
+
+    def __mod__(self, o):
+        return get_method("remainder")(self, o)
+
+    def __neg__(self):
+        return get_method("neg")(self)
+
+    def __abs__(self):
+        return get_method("abs")(self)
+
+    def __matmul__(self, o):
+        return get_method("matmul")(self, o)
+
+    def __rmatmul__(self, o):
+        return get_method("matmul")(o, self)
+
+    def __lt__(self, o):
+        return get_method("lt")(self, o)
+
+    def __le__(self, o):
+        return get_method("le")(self, o)
+
+    def __gt__(self, o):
+        return get_method("gt")(self, o)
+
+    def __ge__(self, o):
+        return get_method("ge")(self, o)
+
+    def __eq__(self, o):
+        return get_method("eq")(self, o)
+
+    def __ne__(self, o):
+        return get_method("ne")(self, o)
+
+    def __and__(self, o):
+        return get_method("bitwise_and")(self, o)
+
+    def __or__(self, o):
+        return get_method("bitwise_or")(self, o)
+
+    def __xor__(self, o):
+        return get_method("bitwise_xor")(self, o)
+
+    def __invert__(self):
+        return get_method("bitwise_not")(self)
+
+    def __getitem__(self, key):
+        return get_method("getitem")(self, key)
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails: resolve tensor methods
+        if name.startswith("__"):
+            raise AttributeError(name)
+        try:
+            method = get_method(name)
+        except AttributeError:
+            raise AttributeError(f"TensorProxy has no attribute/method '{name}'")
+        import functools
+
+        return functools.partial(method, self)
+
+    @property
+    def mT(self):
+        return get_method("matrix_transpose")(self)
+
+    @property
+    def T(self):
+        return get_method("t")(self)
+
+    @property
+    def real(self):
+        return get_method("real")(self)
+
+
+class FutureTensorProxy(TensorProxy):
+    """Result of an async collective; resolved by ``wait`` (reference proxies.py:1318)."""
+
+    _prefix = "f"
+
+    def wait(self) -> TensorProxy:
+        from ..parallel import prims as dist_prims
+
+        return dist_prims.wait(self)
+
+
+def proxy_from_jax(x, *, name: str | None = None, requires_grad: bool = False) -> Proxy:
+    """Build a proxy describing a concrete runtime value."""
+    import numpy as np
+
+    if isinstance(x, Proxy):
+        return x
+    if isinstance(x, (bool, int, float, complex)):
+        return NumberProxy(x, type(x), name)
+    if isinstance(x, str):
+        return StringProxy(x, name)
+    shape = tuple(getattr(x, "shape", ()))
+    dt = dtypes.to_dtype(x)
+    sharding = getattr(x, "sharding", None)
+    dev = devices.default_device()
+    try:
+        jdevs = list(x.devices()) if hasattr(x, "devices") else None
+        if jdevs:
+            dev = devices.to_device(jdevs[0])
+    except Exception:
+        pass
+    return TensorProxy(name, shape=shape, dtype=dt, device=dev, requires_grad=requires_grad)
+
+
+def is_proxyable(x) -> bool:
+    return isinstance(x, (Number, str)) or hasattr(x, "shape")

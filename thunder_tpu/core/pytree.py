@@ -1,0 +1,19 @@
+"""Pytree flatten/unflatten built on jax.tree_util.
+
+Counterpart of reference thunder/core/pytree.py:1-135 (which wraps optree);
+here jax's tree utilities are the natural substrate.
+"""
+from __future__ import annotations
+
+import jax
+
+tree_flatten = jax.tree_util.tree_flatten
+tree_unflatten = jax.tree_util.tree_unflatten
+tree_map = jax.tree_util.tree_map
+tree_leaves = jax.tree_util.tree_leaves
+tree_structure = jax.tree_util.tree_structure
+register_pytree_node = jax.tree_util.register_pytree_node
+
+
+def tree_flatten_with_dataclass(x):
+    return tree_flatten(x)

@@ -1,0 +1,328 @@
+"""jaxex: op-by-op JAX executor — every prim lowered 1:1 to jax.numpy/lax.
+
+This is the TPU stack's "always" executor and numerics reference, the role
+torchex plays in the reference (thunder/executors/torchex.py:1, ~180
+register_implementation calls). All impls are pure jax functions, so any
+contiguous region of them is XLA-fusible by the fusion executor."""
+from __future__ import annotations
+
+import builtins
+import functools
+import math
+from numbers import Number
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import dtypes, prims
+from ..core.dtypes import to_jax_dtype
+from ..core.prims import PrimIDs
+from ..core.proxies import TensorProxy
+from ..extend import OperatorExecutor, register_executor, add_always_executor
+
+ex = OperatorExecutor("jax")
+register_executor(ex)
+add_always_executor(ex)
+
+
+def _jd(dtype):
+    """framework dtype -> jnp dtype, downgrading 64-bit when x64 is disabled."""
+    if dtype is None:
+        return None
+    jd = to_jax_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        jd = {jnp.int64: jnp.int32, jnp.uint32: jnp.uint32, jnp.float64: jnp.float32,
+              jnp.complex128: jnp.complex64}.get(jd, jd)
+    return jd
+
+
+def _reg(pid, fn):
+    ex.register_implementation(pid, fn)
+    return fn
+
+
+# ---- structure / checks ----
+_reg(PrimIDs.PRINT, print)
+_reg(PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, prims.check_tensor_shape_and_metadata.python_impl)
+_reg(PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE, prims.check_number_type_and_value.python_impl)
+
+# ---- dtype/device ----
+_reg(PrimIDs.CONVERT_ELEMENT_TYPE, lambda a, dtype: jnp.asarray(a).astype(_jd(dtype)))
+_reg(PrimIDs.DEVICE_PUT, lambda a, device: jax.device_put(a, device.jax_device()))
+_reg(PrimIDs.STOP_GRADIENT, lax.stop_gradient)
+_reg(PrimIDs.BITCAST, lambda a, dtype: lax.bitcast_convert_type(a, _jd(dtype)))
+
+
+# ---- factories ----
+def _full(shape, fill_value, *, device=None, dtype=None):
+    return jnp.full(shape, fill_value, dtype=_jd(dtype))
+
+
+_reg(PrimIDs.FULL, _full)
+
+
+def _iota(length, *, start=0, step=1, device=None, dtype=None):
+    return jnp.arange(start, start + length * step, step, dtype=_jd(dtype))[:length]
+
+
+_reg(PrimIDs.IOTA, _iota)
+
+
+def _uniform(shape, minval, maxval, *, key, device=None, dtype=None):
+    return jax.random.uniform(key, tuple(shape), _jd(dtype) or jnp.float32, minval, maxval)
+
+
+_reg(PrimIDs.UNIFORM, _uniform)
+
+
+def _normal(shape, mean, std, *, key, device=None, dtype=None):
+    return jax.random.normal(key, tuple(shape), _jd(dtype) or jnp.float32) * std + mean
+
+
+_reg(PrimIDs.NORMAL, _normal)
+
+
+def _randint(shape, low, high, *, key, device=None, dtype=None):
+    return jax.random.randint(key, tuple(shape), low, high, _jd(dtype) or jnp.int32)
+
+
+_reg(PrimIDs.RANDINT, _randint)
+
+
+def _rng_split(key):
+    k = jax.random.split(key, 2)
+    return k[0], k[1]
+
+
+_reg(PrimIDs.RNG_SPLIT, _rng_split)
+
+# ---- shape ops ----
+_reg(PrimIDs.RESHAPE, lambda a, shape: jnp.reshape(a, shape))
+_reg(PrimIDs.TRANSPOSE, lambda a, permutation: jnp.transpose(a, permutation))
+_reg(PrimIDs.BROADCAST_IN_DIM, lambda a, shape, broadcast_dimensions: lax.broadcast_in_dim(a, shape, broadcast_dimensions))
+_reg(PrimIDs.SLICE, lambda a, start_indices, limit_indices, strides=None: lax.slice(a, start_indices, limit_indices, strides))
+_reg(PrimIDs.SQUEEZE, lambda a, dims: lax.squeeze(a, dims))
+_reg(PrimIDs.CAT, lambda tensors, dim: jnp.concatenate(tensors, axis=dim))
+
+
+def _pad(a, padding_value, padding_config):
+    pv = jnp.asarray(padding_value, dtype=a.dtype) if not hasattr(padding_value, "dtype") else padding_value.astype(a.dtype)
+    return lax.pad(a, pv, tuple(tuple(int(x) for x in cfg) for cfg in padding_config))
+
+
+_reg(PrimIDs.PAD, _pad)
+_reg(PrimIDs.FLIP, lambda a, dims: jnp.flip(a, dims))
+_reg(PrimIDs.TAKE, lambda a, indices, dim: jnp.take(a, indices, axis=dim))
+_reg(PrimIDs.TAKE_ALONG_AXIS, lambda a, indices, dim: jnp.take_along_axis(a, indices, axis=dim))
+
+
+def _index_add(a, indices, value, dim):
+    idx = [builtins.slice(None)] * a.ndim
+    idx[dim] = indices
+    return a.at[tuple(idx)].add(value)
+
+
+_reg(PrimIDs.INDEX_ADD, _index_add)
+
+
+def _scatter_add(a, indices, value, dim):
+    return a.at[indices].add(value) if dim == 0 else _scatter_add_general(a, indices, value, dim)
+
+
+def _scatter_add_general(a, indices, value, dim):
+    # torch.scatter_add semantics: indices same rank as a/value
+    dnums = jnp.indices(indices.shape)
+    gather_idx = list(dnums)
+    gather_idx[dim] = indices
+    return a.at[tuple(gather_idx)].add(value)
+
+
+_reg(PrimIDs.SCATTER_ADD, _scatter_add_general)
+_reg(PrimIDs.DYNAMIC_SLICE, lambda a, start_indices, slice_sizes: lax.dynamic_slice(a, start_indices, slice_sizes))
+_reg(PrimIDs.DYNAMIC_UPDATE_SLICE, lambda a, update, start_indices: lax.dynamic_update_slice(a, update, start_indices))
+
+# ---- elementwise unary ----
+_unary_impls = {
+    PrimIDs.ABS: jnp.abs, PrimIDs.NEG: jnp.negative, PrimIDs.EXP: jnp.exp, PrimIDs.EXP2: jnp.exp2,
+    PrimIDs.EXPM1: jnp.expm1, PrimIDs.LOG: jnp.log, PrimIDs.LOG1P: jnp.log1p, PrimIDs.LOG2: jnp.log2,
+    PrimIDs.SQRT: jnp.sqrt, PrimIDs.RSQRT: lax.rsqrt, PrimIDs.SIN: jnp.sin, PrimIDs.COS: jnp.cos,
+    PrimIDs.TAN: jnp.tan, PrimIDs.TANH: jnp.tanh, PrimIDs.ASIN: jnp.arcsin, PrimIDs.ACOS: jnp.arccos,
+    PrimIDs.ATAN: jnp.arctan, PrimIDs.SINH: jnp.sinh, PrimIDs.COSH: jnp.cosh, PrimIDs.ASINH: jnp.arcsinh,
+    PrimIDs.ACOSH: jnp.arccosh, PrimIDs.ATANH: jnp.arctanh, PrimIDs.ERF: lax.erf, PrimIDs.ERFC: lax.erfc,
+    PrimIDs.ERFINV: lax.erf_inv, PrimIDs.FLOOR: jnp.floor, PrimIDs.CEIL: jnp.ceil,
+    PrimIDs.ROUND: jnp.round, PrimIDs.TRUNC: jnp.trunc, PrimIDs.SIGN: jnp.sign,
+    PrimIDs.ISFINITE: jnp.isfinite, PrimIDs.ISNAN: jnp.isnan, PrimIDs.ISINF: jnp.isinf,
+    PrimIDs.RECIPROCAL: jnp.reciprocal, PrimIDs.LOGICAL_NOT: jnp.logical_not,
+    PrimIDs.BITWISE_NOT: jnp.invert, PrimIDs.REAL: jnp.real, PrimIDs.IMAG: jnp.imag,
+}
+for pid, fn in _unary_impls.items():
+    _reg(pid, fn)
+
+# float unary on int inputs should produce f32 (framework semantics)
+for pid in (PrimIDs.EXP, PrimIDs.LOG, PrimIDs.SQRT, PrimIDs.RSQRT, PrimIDs.SIN, PrimIDs.COS,
+            PrimIDs.TANH, PrimIDs.ERF):
+    base = ex.get_impl(pid)
+
+    def _floatify(fn):
+        def wrapped(a):
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.integer) or jnp.asarray(a).dtype == jnp.bool_:
+                a = jnp.asarray(a).astype(jnp.float32)
+            return fn(a)
+
+        return wrapped
+
+    _reg(pid, _floatify(base))
+
+# ---- elementwise binary ----
+_binary_impls = {
+    PrimIDs.ADD: jnp.add, PrimIDs.SUB: jnp.subtract, PrimIDs.MUL: jnp.multiply,
+    PrimIDs.DIV: jnp.true_divide, PrimIDs.POW: jnp.power, PrimIDs.FMOD: jnp.fmod,
+    PrimIDs.REMAINDER: jnp.remainder, PrimIDs.MAXIMUM: jnp.maximum, PrimIDs.MINIMUM: jnp.minimum,
+    PrimIDs.ATAN2: jnp.arctan2, PrimIDs.BITWISE_AND: jnp.bitwise_and,
+    PrimIDs.BITWISE_OR: jnp.bitwise_or, PrimIDs.BITWISE_XOR: jnp.bitwise_xor,
+    PrimIDs.SHIFT_LEFT: jnp.left_shift, PrimIDs.SHIFT_RIGHT: jnp.right_shift,
+    PrimIDs.EQ: jnp.equal, PrimIDs.NE: jnp.not_equal, PrimIDs.LT: jnp.less,
+    PrimIDs.LE: jnp.less_equal, PrimIDs.GT: jnp.greater, PrimIDs.GE: jnp.greater_equal,
+}
+for pid, fn in _binary_impls.items():
+    _reg(pid, fn)
+
+
+def _div_torch(a, b):
+    # torch true_divide on ints promotes to float; prim contract says clang
+    # already promoted, so plain divide is correct here
+    return jnp.true_divide(a, b)
+
+
+_reg(PrimIDs.DIV, _div_torch)
+_reg(PrimIDs.WHERE, jnp.where)
+
+# ---- reductions ----
+_reg(PrimIDs.SUM, lambda a, dims, *, output_dtype=None: jnp.sum(a, axis=dims, dtype=_jd(output_dtype)))
+_reg(PrimIDs.PROD, lambda a, dims, *, output_dtype=None: jnp.prod(a, axis=dims, dtype=_jd(output_dtype)))
+_reg(PrimIDs.AMAX, lambda a, dims: jnp.max(a, axis=dims))
+_reg(PrimIDs.AMIN, lambda a, dims: jnp.min(a, axis=dims))
+_reg(PrimIDs.ARGMAX, lambda a, dim: jnp.argmax(a, axis=dim).astype(_jd(dtypes.int64)))
+_reg(PrimIDs.ARGMIN, lambda a, dim: jnp.argmin(a, axis=dim).astype(_jd(dtypes.int64)))
+_reg(PrimIDs.ANY, lambda a, dims: jnp.any(a, axis=dims))
+_reg(PrimIDs.CUMSUM, lambda a, dim: jnp.cumsum(a, axis=dim))
+_reg(PrimIDs.TOPK, lambda a, k, dim: _topk(a, k, dim))
+
+
+def _topk(a, k, dim):
+    if dim != a.ndim - 1 and dim != -1:
+        a_m = jnp.moveaxis(a, dim, -1)
+        v, i = lax.top_k(a_m, k)
+        return jnp.moveaxis(v, -1, dim), jnp.moveaxis(i, -1, dim).astype(jnp.int32)
+    v, i = lax.top_k(a, k)
+    return v, i.astype(jnp.int32)
+
+
+_reg(PrimIDs.ARGSORT, lambda a, dim, descending=False: (
+    jnp.argsort(-a if descending else a, axis=dim).astype(jnp.int32)))
+_reg(PrimIDs.SORT, lambda a, dim, descending=False: (-jnp.sort(-a, axis=dim) if descending else jnp.sort(a, axis=dim)))
+
+
+# ---- linear algebra / NN: MXU ops with bf16-friendly accumulation ----
+def _matmul(a, b):
+    # accumulate in f32 on the MXU regardless of input precision
+    return jnp.matmul(a, b, preferred_element_type=_preferred_acc(a))
+
+
+def _preferred_acc(a):
+    d = jnp.asarray(a).dtype
+    if d in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+def _matmul_cast(a, b):
+    out = jnp.matmul(a, b, preferred_element_type=_preferred_acc(a))
+    return out.astype(jnp.asarray(a).dtype)
+
+
+_reg(PrimIDs.MATMUL, _matmul_cast)
+
+
+def _linear(a, w, bias=None):
+    out = jnp.matmul(a, w.T, preferred_element_type=_preferred_acc(a)).astype(jnp.asarray(a).dtype)
+    return out
+
+
+_reg(PrimIDs.LINEAR, _linear)
+
+
+def _convolution(a, weight, bias, stride, padding, dilation, groups):
+    n_spatial = a.ndim - 2
+    dim_chars = "DHW"[-n_spatial:] if n_spatial <= 3 else None
+    lhs_spec = "NC" + dim_chars
+    rhs_spec = "OI" + dim_chars
+    out = lax.conv_general_dilated(
+        a, weight,
+        window_strides=tuple(stride),
+        padding=tuple((p, p) for p in padding),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=groups,
+        preferred_element_type=_preferred_acc(a),
+    ).astype(jnp.asarray(a).dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n_spatial)
+    return out
+
+
+_reg(PrimIDs.CONVOLUTION, _convolution)
+_reg(PrimIDs.EMBEDDING, lambda indices, weight: jnp.take(weight, indices, axis=0))
+
+
+def _grouped_mm(a, b, group_sizes):
+    return lax.ragged_dot(a, b, group_sizes.astype(jnp.int32),
+                          preferred_element_type=_preferred_acc(a)).astype(jnp.asarray(a).dtype)
+
+
+_reg(PrimIDs.GROUPED_MM, _grouped_mm)
+
+# ---- memory / interop ----
+_reg(PrimIDs.ITEM, lambda a: a.item())
+
+
+def _copy_with_setitem(a, key, value):
+    return a.at[key].set(value)
+
+
+_reg(PrimIDs.COPY_WITH_SETITEM, _copy_with_setitem)
+_reg(PrimIDs.UPDATE_ALIASES, lambda tensors: tuple(tensors))
+
+
+# ---------------------------------------------------------------------------
+# eager escape hatch: execute a symbol on concrete values by tracing it
+# ---------------------------------------------------------------------------
+
+
+def eager_execute(sym, *args, **kwargs):
+    from ..core.proxies import proxy_from_jax, Proxy
+    from ..core.trace import TraceCtx, tracectx
+    from ..core import prims as _p
+
+    trc = TraceCtx(None)
+    flat_concrete = []
+    with tracectx(trc):
+        def proxify(x):
+            if isinstance(x, (Number, str, type(None), tuple, list, dict, dtypes.dtype)):
+                return x
+            p = proxy_from_jax(x)
+            if isinstance(p, Proxy) and not isinstance(x, Proxy):
+                flat_concrete.append((p, x))
+            return p
+
+        pargs = [proxify(a) for a in args]
+        pkwargs = {k: proxify(v) for k, v in kwargs.items()}
+        out = sym(*pargs, **pkwargs)
+        _p.python_return(out)
+    trc.args = tuple(p for p, _ in flat_concrete)
+    from .passes import transform_for_execution
+
+    trc = transform_for_execution(trc, [ex])
+    fn = trc.python_callable()
+    return fn(*[v for _, v in flat_concrete])

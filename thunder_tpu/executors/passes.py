@@ -1,0 +1,81 @@
+"""Execution passes: executor claiming and fusion.
+
+Re-design of reference thunder/executors/passes.py:32-288. Priority-order
+claiming: executor execution-transform → executor impl at the bsym's level →
+descend into subsymbols → error on unclaimed prims. Then each FusionExecutor's
+fusion_pass groups claimed ops into XLA-compiled regions."""
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from ..core.prims import PrimIDs
+from ..core.symbol import BoundSymbol, OpTags
+from ..core.trace import TraceCtx, from_trace, tracectx
+from ..extend import Executor, FusionExecutor, get_always_executors
+
+_STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
+
+
+def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> TraceCtx:
+    start = time.perf_counter()
+    executors = list(executors)
+    for al in get_always_executors():
+        if al not in executors:
+            executors.append(al)
+
+    out_bsyms: list[BoundSymbol] = []
+
+    def lower(bsym: BoundSymbol):
+        if bsym.sym.id in _STRUCTURAL:
+            out_bsyms.append(bsym)
+            return
+        if bsym.sym.python_impl is not None and bsym.impl is None and bsym.sym.executor is None:
+            # pure-python symbols (prologue checks) execute directly
+            out_bsyms.append(bsym.with_impl(bsym.sym.python_impl))
+            return
+        if bsym.sym.executor is not None:
+            # already executor-bound (e.g. registered operator symbols)
+            impl = bsym.sym.executor.get_impl(bsym.sym.id)
+            if impl is not None:
+                out_bsyms.append(bsym.with_impl(impl))
+                return
+        for ex in executors:
+            if ex.is_fusion_executor():
+                continue
+            if ex.can_execute(bsym):
+                info = ex.implmap.get(bsym.sym.id)
+                if info is not None and info.execution_transform is not None:
+                    # re-trace the replacement into prims/ops of the executor
+                    new_trc = TraceCtx(None)
+                    with tracectx(new_trc):
+                        info.execution_transform(*bsym.args, **bsym.kwargs)
+                    for sub in new_trc.bound_symbols:
+                        lower(sub)
+                    return
+                impl = ex.get_impl(bsym.sym.id)
+                if impl is not None:
+                    out_bsyms.append(bsym.with_impl(impl))
+                    return
+        if bsym.subsymbols:
+            for sub in bsym.subsymbols:
+                lower(sub)
+            return
+        raise RuntimeError(
+            f"no executor can run {bsym.sym.name} (id={bsym.sym.id}); "
+            f"tried {[e.name for e in executors]}"
+        )
+
+    for bsym in trace.bound_symbols:
+        lower(bsym)
+
+    claimed = from_trace(trace)
+    claimed.bound_symbols = out_bsyms
+    claimed.set_provenance(
+        f"Transform for execution (took {(time.perf_counter()-start)*1000:.2f} ms)"
+    )
+
+    for ex in executors:
+        if isinstance(ex, FusionExecutor) or ex.is_fusion_executor():
+            claimed = ex.fusion_pass(claimed)
+    return claimed

@@ -1,0 +1,162 @@
+"""Executor/extension system: the pluggable backend registry.
+
+Re-design of reference thunder/extend/__init__.py:53-659. Executors claim
+BoundSymbols at any level of the hierarchy: OperatorExecutors provide concrete
+implementations per symbol id; FusionExecutors group claimed regions into
+compiled fusions (here: ``jax.jit`` → XLA, the TPU analog of nvFuser).
+``register_operator`` remains *the* extension point for custom kernels
+(e.g. Pallas flash-attention registering against ``sdpa``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from .core.symbol import BoundSymbol, Symbol
+from .core.trace import TraceCtx
+
+
+class ImplInfo:
+    __slots__ = ("symbol", "execution_transform", "checker", "grad_transform")
+
+    def __init__(self, symbol=None, execution_transform=None, checker=None, grad_transform=None):
+        self.symbol = symbol
+        self.execution_transform = execution_transform  # fn(*args, **kwargs) -> proxies, traced replacement
+        self.checker = checker  # fn(*args, **kwargs) -> bool
+        self.grad_transform = grad_transform  # executor-claimed grads (reference autodiff.py:28-40 priority)
+
+
+class Executor:
+    def __init__(self, name: str, *, version: str = "0.1"):
+        self.name = name
+        self.version = version
+        self.implmap: dict[Any, ImplInfo] = {}
+        # concrete callables per symbol id (what generated code invokes)
+        self.opmap: dict[Any, Callable] = {}
+
+    def __repr__(self) -> str:
+        return f"<Executor {self.name}>"
+
+    def can_execute(self, bsym: BoundSymbol) -> bool:
+        info = self.implmap.get(bsym.sym.id)
+        if info is None:
+            return False
+        if info.checker is not None:
+            try:
+                return bool(info.checker(*bsym.args, **bsym.kwargs))
+            except Exception:
+                return False
+        return True
+
+    def get_impl(self, sym_id) -> Optional[Callable]:
+        return self.opmap.get(sym_id)
+
+    def get_grad_transform(self, sym_id):
+        info = self.implmap.get(sym_id)
+        return info.grad_transform if info else None
+
+    def is_fusion_executor(self) -> bool:
+        return False
+
+
+class OperatorExecutor(Executor):
+    def register_operator(self, name: str, *, meta: Callable | None = None, fn: Callable,
+                          replaces=None, tags=()) -> Symbol:
+        """Create a Symbol backed by a concrete impl (reference extend/__init__.py:206
+        OperatorExecutor.register_operator — the custom-kernel extension point)."""
+        sym = Symbol(name, meta, id=f"{self.name}.{name}", is_prim=True, module=self.name,
+                     executor=self, tags=tags)
+        self.opmap[sym.id] = fn
+        self.implmap[sym.id] = ImplInfo(symbol=sym)
+        if replaces is not None:
+            rep_ids = replaces if isinstance(replaces, (tuple, list)) else (replaces,)
+            for rid in rep_ids:
+                rid = rid.id if isinstance(rid, Symbol) else rid
+                self.opmap[rid] = fn
+                self.implmap[rid] = ImplInfo(symbol=sym)
+        return sym
+
+    def register_implementation(self, sym_or_id, fn: Callable, *, checker=None, grad_transform=None,
+                                execution_transform=None) -> None:
+        sym_id = sym_or_id.id if isinstance(sym_or_id, Symbol) else sym_or_id
+        self.opmap[sym_id] = fn
+        self.implmap[sym_id] = ImplInfo(checker=checker, grad_transform=grad_transform,
+                                        execution_transform=execution_transform)
+
+
+class FusionExecutor(Executor):
+    def is_fusion_executor(self) -> bool:
+        return True
+
+    def fusion_pass(self, trace: TraceCtx) -> TraceCtx:
+        raise NotImplementedError
+
+
+class TemporaryExecutor(OperatorExecutor):
+    """Per-jit ad-hoc ops for opaque callables (reference extend/__init__.py:356)."""
+
+    _counter = 0
+
+    def __init__(self):
+        TemporaryExecutor._counter += 1
+        super().__init__(f"__ad_hoc_{TemporaryExecutor._counter}")
+
+
+# ---------------------------------------------------------------------------
+# global registry (reference extend/__init__.py:525-659)
+# ---------------------------------------------------------------------------
+
+_executor_registry: dict[str, Executor] = {}
+_default_executors: list[Executor] = []
+_always_executors: list[Executor] = []
+
+
+def register_executor(ex: Executor) -> Executor:
+    _executor_registry[ex.name] = ex
+    return ex
+
+
+def get_executor(name: str) -> Executor:
+    ex = _executor_registry.get(name)
+    if ex is None:
+        raise LookupError(f"unknown executor '{name}' (known: {sorted(_executor_registry)})")
+    return ex
+
+
+def get_all_executors() -> tuple[Executor, ...]:
+    return tuple(_executor_registry.values())
+
+
+def set_default_executors(exs: Sequence[Executor]) -> None:
+    _default_executors.clear()
+    _default_executors.extend(exs)
+
+
+def get_default_executors() -> tuple[Executor, ...]:
+    return tuple(_default_executors)
+
+
+def set_always_executors(exs: Sequence[Executor]) -> None:
+    _always_executors.clear()
+    _always_executors.extend(exs)
+
+
+def get_always_executors() -> tuple[Executor, ...]:
+    return tuple(_always_executors)
+
+
+def resolve_executors(executors) -> tuple[Executor, ...]:
+    if executors is None:
+        return get_default_executors()
+    out = []
+    for e in executors:
+        if isinstance(e, Executor):
+            out.append(e)
+        elif isinstance(e, str):
+            out.append(get_executor(e))
+        else:
+            raise TypeError(f"cannot resolve executor {e!r}")
+    return tuple(out)
+
+
+def add_always_executor(ex: Executor) -> None:
+    if ex not in _always_executors:
+        _always_executors.append(ex)

@@ -1,0 +1,14 @@
+from .module import Module, ModuleDict, ModuleList, Parameter, Sequential, ThunderModule, functional_params
+from .layers import (
+    Conv2d,
+    Dropout,
+    Embedding,
+    GELU,
+    LayerNorm,
+    Linear,
+    ReLU,
+    RMSNorm,
+    Sigmoid,
+    SiLU,
+    Tanh,
+)

@@ -1,0 +1,152 @@
+"""Standard NN layers in the framework's own op language.
+
+These call thunder_tpu.ops.ltorch symbols inside Module.forward, so tracing a
+model records ltorch bsyms (which decompose to prims) — the shape the
+reference gets from tracing torch.nn layers through its interpreter."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..ops import ltorch
+from .module import Module, Parameter
+
+
+def _key(seed):
+    return jax.random.PRNGKey(seed)
+
+
+_init_counter = [0]
+
+
+def _next_seed(seed=None) -> int:
+    if seed is not None:
+        return seed
+    _init_counter[0] += 1
+    return _init_counter[0]
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True, *,
+                 dtype=jnp.float32, seed: int | None = None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        k = _key(_next_seed(seed))
+        bound = 1.0 / math.sqrt(in_features)
+        self.weight = Parameter(jax.random.uniform(k, (out_features, in_features), dtype, -bound, bound))
+        if bias:
+            k2 = jax.random.fold_in(k, 1)
+            self.bias = Parameter(jax.random.uniform(k2, (out_features,), dtype, -bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return ltorch.linear(x, self.weight, self.bias)
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int, *, dtype=jnp.float32, seed: int | None = None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        k = _key(_next_seed(seed))
+        self.weight = Parameter(jax.random.normal(k, (num_embeddings, embedding_dim), dtype))
+
+    def forward(self, idx):
+        return ltorch.embedding(idx, self.weight)
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, eps: float = 1e-5, elementwise_affine: bool = True, *,
+                 bias: bool = True, dtype=jnp.float32):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        if elementwise_affine:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, dtype))
+            self.bias = Parameter(jnp.zeros(self.normalized_shape, dtype)) if bias else None
+        else:
+            self.weight = None
+            self.bias = None
+
+    def forward(self, x):
+        return ltorch.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.eps)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-6, *, dtype=jnp.float32):
+        super().__init__()
+        self.dim = dim
+        self.eps = eps
+        self.weight = Parameter(jnp.ones((dim,), dtype))
+
+    def forward(self, x):
+        return ltorch.rms_norm(x, (self.dim,), self.weight, self.eps)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.0):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x, key=None):
+        if not self.training or self.p == 0.0 or key is None:
+            return x
+        return ltorch.dropout(x, self.p, training=True, key=key)
+
+
+class GELU(Module):
+    def __init__(self, approximate: str = "none"):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return ltorch.gelu(x, approximate=self.approximate)
+
+
+class ReLU(Module):
+    def forward(self, x):
+        return ltorch.relu(x)
+
+
+class SiLU(Module):
+    def forward(self, x):
+        return ltorch.silu(x)
+
+
+class Tanh(Module):
+    def forward(self, x):
+        return ltorch.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, x):
+        return ltorch.sigmoid(x)
+
+
+class Conv2d(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1,
+                 groups=1, bias=True, *, dtype=jnp.float32, seed: int | None = None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+        self.dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+        self.groups = groups
+        k = _key(_next_seed(seed))
+        fan_in = in_channels // groups * ks[0] * ks[1]
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = Parameter(jax.random.uniform(k, (out_channels, in_channels // groups, *ks), dtype, -bound, bound))
+        if bias:
+            self.bias = Parameter(jax.random.uniform(jax.random.fold_in(k, 1), (out_channels,), dtype, -bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return ltorch.conv2d(x, self.weight, self.bias, self.stride, self.padding, self.dilation, self.groups)

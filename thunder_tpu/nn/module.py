@@ -1,0 +1,309 @@
+"""Torch-style module system over jax arrays + the ThunderModule wrapper.
+
+The reference wraps ``torch.nn.Module`` (thunder/core/module.py:30
+ThunderModule with parameter overrides, state_dict round-trip, no_sync).
+TPU-native, the framework owns its module system: parameters are jax arrays
+held in a stateful ``Module`` tree; tracing swaps params for proxies via a
+functional call, so the computation trace takes parameters as explicit inputs
+(the same shape the reference achieves with prologue param-unpacking)."""
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.proxies import TensorProxy
+
+
+class Parameter:
+    """A learnable leaf: jax array + requires_grad flag."""
+
+    def __init__(self, data, requires_grad: bool = True):
+        self.data = data
+        self.requires_grad = requires_grad
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    def __jax_array__(self):
+        return self.data
+
+    def __repr__(self):
+        return f"Parameter(shape={tuple(self.shape)}, dtype={self.dtype}, requires_grad={self.requires_grad})"
+
+
+class Module:
+    """Stateful module tree (torch-flavored API, jax-array parameters)."""
+
+    def __init__(self):
+        object.__setattr__(self, "_parameters", {})
+        object.__setattr__(self, "_buffers", {})
+        object.__setattr__(self, "_modules", {})
+        object.__setattr__(self, "training", True)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self._buffers.pop(name, None)
+            self._modules.pop(name, None)
+        elif isinstance(value, Module):
+            self._modules[name] = value
+            self._parameters.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_modules"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name}")
+
+    def register_buffer(self, name: str, value) -> None:
+        self._buffers[name] = value
+
+    def register_parameter(self, name: str, value: Parameter) -> None:
+        self._parameters[name] = value
+
+    # --- traversal ---
+    def named_modules(self, prefix: str = "") -> Iterator[tuple[str, "Module"]]:
+        yield prefix, self
+        for name, mod in self._modules.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from mod.named_modules(sub_prefix)
+
+    def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, Parameter]]:
+        for mod_name, mod in self.named_modules(prefix):
+            for p_name, p in mod._parameters.items():
+                yield (f"{mod_name}.{p_name}" if mod_name else p_name), p
+
+    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+        for mod_name, mod in self.named_modules(prefix):
+            for b_name, b in mod._buffers.items():
+                yield (f"{mod_name}.{b_name}" if mod_name else b_name), b
+
+    def parameters(self) -> Iterator[Parameter]:
+        for _, p in self.named_parameters():
+            yield p
+
+    def modules(self) -> Iterator["Module"]:
+        for _, m in self.named_modules():
+            yield m
+
+    # --- state dict ---
+    def state_dict(self) -> dict:
+        out = {name: p.data for name, p in self.named_parameters()}
+        out.update({name: b for name, b in self.named_buffers()})
+        return out
+
+    def load_state_dict(self, sd: dict, strict: bool = True) -> None:
+        own_params = dict(self.named_parameters())
+        own_buffers = dict(self.named_buffers())
+        for k, v in sd.items():
+            if k in own_params:
+                own_params[k].data = jnp.asarray(v)
+            elif k in own_buffers:
+                self._set_buffer_by_path(k, jnp.asarray(v))
+            elif strict:
+                raise KeyError(f"unexpected key {k} in state_dict")
+        if strict:
+            missing = set(own_params) - set(sd)
+            if missing:
+                raise KeyError(f"missing keys in state_dict: {sorted(missing)}")
+
+    def _set_buffer_by_path(self, path: str, value) -> None:
+        parts = path.split(".")
+        mod = self
+        for p in parts[:-1]:
+            mod = mod._modules[p]
+        mod._buffers[parts[-1]] = value
+
+    # --- modes ---
+    def train(self, mode: bool = True) -> "Module":
+        for m in self.modules():
+            object.__setattr__(m, "training", mode)
+        return self
+
+    def eval(self) -> "Module":
+        return self.train(False)
+
+    def to(self, dtype=None) -> "Module":
+        if dtype is not None:
+            jd = dtypes.to_jax_dtype(dtypes.to_dtype(dtype))
+            for p in self.parameters():
+                if jnp.issubdtype(p.data.dtype, jnp.floating):
+                    p.data = p.data.astype(jd)
+        return self
+
+    # --- call ---
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+
+@contextmanager
+def functional_params(module: Module, param_map: dict):
+    """Temporarily replace parameters (by qualified name) with given values —
+    the tracing-time analog of the reference's ThunderModule overrides
+    (thunder/core/module.py:30)."""
+    saved = []
+    for mod_name, mod in module.named_modules():
+        for p_name in list(mod._parameters):
+            q = f"{mod_name}.{p_name}" if mod_name else p_name
+            if q in param_map:
+                saved.append((mod, p_name, mod._parameters[p_name]))
+                mod._parameters[p_name] = param_map[q]
+    try:
+        yield
+    finally:
+        for mod, p_name, orig in saved:
+            mod._parameters[p_name] = orig
+
+
+class ThunderModule:
+    """Compiled wrapper around a Module (reference thunder/core/module.py:30).
+
+    Parameters are pulled fresh from the module on every call, so optimizer
+    updates and transform-installed overrides (sharded / quantized params)
+    take effect without retracing as long as metadata matches."""
+
+    def __init__(self, module: Module, *, executors=None, transforms=None, cache="constant values",
+                 disable_fusion=False, **compile_options):
+        from .. import jit as _jit
+
+        self._module = module
+        self._overrides: dict = {}
+
+        def _traced(params: dict, args: tuple, kwargs: dict):
+            with functional_params(module, params):
+                return module(*args, **kwargs)
+
+        _traced.__name__ = f"{type(module).__name__}_forward"
+
+        transforms = list(transforms or ())
+        for tf in transforms:
+            tf.transform_module(self)
+
+        self._cfn = _jit(_traced, executors=executors, cache=cache,
+                         transforms=transforms, disable_fusion=disable_fusion, **compile_options)
+
+    @property
+    def module(self) -> Module:
+        return self._module
+
+    @property
+    def _cs(self):
+        return self._cfn._cs
+
+    @property
+    def _cd(self):
+        return self._cfn._cd
+
+    def get_parameters(self) -> dict:
+        params = dict(self._module.named_parameters())
+        params.update(self._overrides)
+        return params
+
+    def set_override(self, name: str, param: Parameter) -> None:
+        """Install a parameter override (sharded/quantized replacement)."""
+        self._overrides[name] = param
+
+    def __call__(self, *args, **kwargs):
+        return self._cfn(self.get_parameters(), args, kwargs)
+
+    def state_dict(self):
+        return self._module.state_dict()
+
+    def load_state_dict(self, sd, strict=True):
+        return self._module.load_state_dict(sd, strict)
+
+    def named_parameters(self):
+        return self.get_parameters().items()
+
+    def train(self, mode=True):
+        self._module.train(mode)
+        return self
+
+    def eval(self):
+        self._module.eval()
+        return self
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+
+class Sequential(Module):
+    def __init__(self, *mods: Module):
+        super().__init__()
+        for i, m in enumerate(mods):
+            setattr(self, str(i), m)
+        self._n = len(mods)
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return self._modules[str(i)]
+
+    def forward(self, x):
+        for m in self._modules.values():
+            x = m(x)
+        return x
+
+
+class ModuleList(Module):
+    def __init__(self, mods: Sequence[Module] = ()):
+        super().__init__()
+        self._n = 0
+        for m in mods:
+            self.append(m)
+
+    def append(self, m: Module):
+        setattr(self, str(self._n), m)
+        self._n += 1
+        return self
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return list(self._modules.values())[i]
+        return self._modules[str(i % self._n if i < 0 else i)]
+
+
+class ModuleDict(Module):
+    def __init__(self, mods: dict | None = None):
+        super().__init__()
+        for k, v in (mods or {}).items():
+            setattr(self, k, v)
+
+    def __getitem__(self, k):
+        return self._modules[k]
+
+    def items(self):
+        return self._modules.items()

@@ -1,0 +1,572 @@
+"""Core operation language: broadcasting, type promotion, indexing.
+
+Counterpart of reference thunder/clang/__init__.py:44 (132 clang ops). These
+are plain helper functions (not Symbols) that normalize arguments and call
+prims; the torch-like Symbol layer above them (ops/ltorch.py) is what records
+into traces as named composite ops."""
+from __future__ import annotations
+
+from numbers import Number
+from typing import Any, Sequence
+
+from ..core import dtypes, prims
+from ..core.baseutils import canonicalize_dim, canonicalize_dims, check
+from ..core.proxies import NumberProxy, TensorProxy, pyval
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, TensorProxy)
+
+
+# ---------------------------------------------------------------------------
+# dtype conversion & promotion
+# ---------------------------------------------------------------------------
+
+
+def maybe_convert_to_dtype(a, dtype: dtypes.dtype):
+    if isinstance(a, TensorProxy):
+        if a.dtype == dtype:
+            return a
+        return prims.convert_element_type(a, dtype)
+    if isinstance(a, (Number, NumberProxy)):
+        return dtypes.dtype_to_numbertype(dtype)(pyval(a))
+    raise ValueError(f"cannot convert {a} to {dtype}")
+
+
+def _result_dtype(*args, int_to_float=False) -> dtypes.dtype:
+    parts = []
+    for a in args:
+        if isinstance(a, TensorProxy):
+            parts.append(a.dtype)
+        elif isinstance(a, (bool,)):
+            parts.append(bool)
+        elif isinstance(a, int):
+            parts.append(int)
+        elif isinstance(a, float):
+            parts.append(float)
+        elif isinstance(a, complex):
+            parts.append(complex)
+        elif isinstance(a, NumberProxy):
+            parts.append(a.python_type)
+    d = dtypes.promote_dtypes(*parts)
+    if int_to_float and not d.is_inexact:
+        d = dtypes.float32
+    return d
+
+
+# ---------------------------------------------------------------------------
+# broadcasting
+# ---------------------------------------------------------------------------
+
+
+def compute_broadcast_shape(*shapes) -> tuple:
+    shapes = [s for s in shapes if s is not None]
+    rank = max(len(s) for s in shapes)
+    out = [1] * rank
+    for s in shapes:
+        off = rank - len(s)
+        for i, d in enumerate(s):
+            if d != 1:
+                check(out[off + i] in (1, d), lambda: f"cannot broadcast shapes {shapes}")
+                out[off + i] = d
+    return tuple(out)
+
+
+def maybe_broadcast(*args):
+    """Broadcast all tensor args to a common shape (numbers pass through)."""
+    shapes = [a.shape for a in args if isinstance(a, TensorProxy)]
+    if not shapes:
+        return args
+    common = compute_broadcast_shape(*shapes)
+    out = []
+    for a in args:
+        if isinstance(a, TensorProxy):
+            out.append(expand_to(a, common))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def expand_to(a: TensorProxy, shape: tuple) -> TensorProxy:
+    if a.shape == tuple(shape):
+        return a
+    off = len(shape) - a.ndim
+    bdims = tuple(range(off, len(shape)))
+    return prims.broadcast_in_dim(a, tuple(shape), bdims)
+
+
+def _elementwise_binary(prim, a, b, *, int_to_float=False, bool_out=False):
+    dt = _result_dtype(a, b, int_to_float=int_to_float)
+    a, b = maybe_broadcast(a, b)
+    if not bool_out:
+        a = maybe_convert_to_dtype(a, dt) if isinstance(a, TensorProxy) else a
+        b = maybe_convert_to_dtype(b, dt) if isinstance(b, TensorProxy) else b
+    else:
+        # comparisons: make tensor dtypes agree, output bool
+        ta = a.dtype if isinstance(a, TensorProxy) else None
+        tb = b.dtype if isinstance(b, TensorProxy) else None
+        if ta is not None and tb is not None and ta != tb:
+            a = maybe_convert_to_dtype(a, dt)
+            b = maybe_convert_to_dtype(b, dt)
+    if not isinstance(a, TensorProxy) and not isinstance(b, TensorProxy):
+        raise NotImplementedError("number-number ops should be computed statically")
+    if not isinstance(a, TensorProxy):
+        a = full_like(b, pyval(a), dtype=dt if not bool_out else None)
+    if not isinstance(b, TensorProxy):
+        b = full_like(a, pyval(b), dtype=dt if not bool_out else None)
+    return prim(a, b)
+
+
+# elementwise binary wrappers ------------------------------------------------
+
+
+def add(a, b):
+    return _elementwise_binary(prims.add, a, b)
+
+
+def sub(a, b):
+    return _elementwise_binary(prims.sub, a, b)
+
+
+def mul(a, b):
+    return _elementwise_binary(prims.mul, a, b)
+
+
+def true_divide(a, b):
+    return _elementwise_binary(prims.div, a, b, int_to_float=True)
+
+
+def floor_divide(a, b):
+    q = _elementwise_binary(prims.div, a, b)
+    if q.dtype.is_float:
+        return prims.floor(q)
+    return q
+
+
+def pow_(a, b):
+    return _elementwise_binary(prims.pow, a, b)
+
+
+def remainder(a, b):
+    return _elementwise_binary(prims.remainder, a, b)
+
+
+def fmod(a, b):
+    return _elementwise_binary(prims.fmod, a, b)
+
+
+def maximum(a, b):
+    return _elementwise_binary(prims.maximum, a, b)
+
+
+def minimum(a, b):
+    return _elementwise_binary(prims.minimum, a, b)
+
+
+def atan2(a, b):
+    return _elementwise_binary(prims.atan2, a, b, int_to_float=True)
+
+
+def bitwise_and(a, b):
+    return _elementwise_binary(prims.bitwise_and, a, b)
+
+
+def bitwise_or(a, b):
+    return _elementwise_binary(prims.bitwise_or, a, b)
+
+
+def bitwise_xor(a, b):
+    return _elementwise_binary(prims.bitwise_xor, a, b)
+
+
+def eq(a, b):
+    return _elementwise_binary(prims.eq, a, b, bool_out=True)
+
+
+def ne(a, b):
+    return _elementwise_binary(prims.ne, a, b, bool_out=True)
+
+
+def lt(a, b):
+    return _elementwise_binary(prims.lt, a, b, bool_out=True)
+
+
+def le(a, b):
+    return _elementwise_binary(prims.le, a, b, bool_out=True)
+
+
+def gt(a, b):
+    return _elementwise_binary(prims.gt, a, b, bool_out=True)
+
+
+def ge(a, b):
+    return _elementwise_binary(prims.ge, a, b, bool_out=True)
+
+
+def logical_and(a, b):
+    return bitwise_and(to_bool(a), to_bool(b))
+
+
+def logical_or(a, b):
+    return bitwise_or(to_bool(a), to_bool(b))
+
+
+def to_bool(a):
+    if isinstance(a, TensorProxy) and not a.dtype.is_bool:
+        return prims.ne(a, full_like(a, 0))
+    return a
+
+
+def where(pred, a, b):
+    dt = _result_dtype(a, b)
+    pred, a, b = maybe_broadcast(pred, a, b)
+    if isinstance(a, TensorProxy):
+        a = maybe_convert_to_dtype(a, dt)
+    if isinstance(b, TensorProxy):
+        b = maybe_convert_to_dtype(b, dt)
+    if not isinstance(a, TensorProxy):
+        a = full_like(pred, pyval(a), dtype=dt)
+    if not isinstance(b, TensorProxy):
+        b = full_like(pred, pyval(b), dtype=dt)
+    return prims.where(pred, a, b)
+
+
+# factories ------------------------------------------------------------------
+
+
+def full(shape, fill_value, *, device=None, dtype=None):
+    return prims.full(tuple(shape), fill_value, device=device, dtype=dtype)
+
+
+def full_like(a: TensorProxy, fill_value, *, device=None, dtype=None):
+    return prims.full(a.shape, fill_value, device=device or a.device, dtype=dtype or a.dtype)
+
+
+def arange(start, stop=None, step=1, *, device=None, dtype=None):
+    if stop is None:
+        start, stop = 0, start
+    length = max(0, -(-(pyval(stop) - pyval(start)) // pyval(step)))
+    if dtype is None:
+        if any(isinstance(pyval(x), float) for x in (start, stop, step)):
+            dtype = dtypes.float32
+        else:
+            dtype = dtypes.int64
+    return prims.iota(length, start=pyval(start), step=pyval(step), device=device, dtype=dtype)
+
+
+# shape ops ------------------------------------------------------------------
+
+
+def reshape(a: TensorProxy, shape) -> TensorProxy:
+    shape = tuple(int(pyval(s)) for s in shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape = tuple(a.numel // known if s == -1 else s for s in shape)
+    if shape == a.shape:
+        return a
+    return prims.reshape(a, shape)
+
+
+def permute(a: TensorProxy, dims) -> TensorProxy:
+    dims = canonicalize_dims(a.ndim, tuple(dims))
+    if dims == tuple(range(a.ndim)):
+        return a
+    return prims.transpose(a, dims)
+
+
+def transpose(a: TensorProxy, dim0: int, dim1: int) -> TensorProxy:
+    dim0, dim1 = canonicalize_dim(a.ndim, dim0), canonicalize_dim(a.ndim, dim1)
+    perm = list(range(a.ndim))
+    perm[dim0], perm[dim1] = perm[dim1], perm[dim0]
+    return permute(a, perm)
+
+
+def matrix_transpose(a: TensorProxy) -> TensorProxy:
+    if a.ndim < 2:
+        return a
+    return transpose(a, -2, -1)
+
+
+def unsqueeze(a: TensorProxy, dim: int) -> TensorProxy:
+    dim = canonicalize_dim(a.ndim + 1, dim)
+    shape = a.shape[:dim] + (1,) + a.shape[dim:]
+    return prims.reshape(a, shape)
+
+
+def squeeze(a: TensorProxy, dim=None) -> TensorProxy:
+    if dim is None:
+        dims = tuple(i for i, s in enumerate(a.shape) if s == 1)
+    else:
+        dims = (canonicalize_dim(a.ndim, pyval(dim)),)
+        if a.shape[dims[0]] != 1:
+            return a
+    if not dims:
+        return a
+    return prims.squeeze(a, dims)
+
+
+def flatten(a: TensorProxy, start_dim=0, end_dim=-1) -> TensorProxy:
+    start_dim = canonicalize_dim(a.ndim, start_dim)
+    end_dim = canonicalize_dim(a.ndim, end_dim)
+    mid = 1
+    for s in a.shape[start_dim : end_dim + 1]:
+        mid *= s
+    shape = a.shape[:start_dim] + (mid,) + a.shape[end_dim + 1 :]
+    return reshape(a, shape)
+
+
+def slice_in_dim(a: TensorProxy, start, stop, dim=0, stride=1) -> TensorProxy:
+    dim = canonicalize_dim(a.ndim, dim)
+    starts = [0] * a.ndim
+    limits = list(a.shape)
+    strides = [1] * a.ndim
+    starts[dim], limits[dim], strides[dim] = start, stop, stride
+    return prims.slice_prim(a, tuple(starts), tuple(limits), tuple(strides))
+
+
+def split(a: TensorProxy, split_size_or_sections, dim=0):
+    dim = canonicalize_dim(a.ndim, dim)
+    n = a.shape[dim]
+    if isinstance(split_size_or_sections, int):
+        sizes = [split_size_or_sections] * (n // split_size_or_sections)
+        if n % split_size_or_sections:
+            sizes.append(n % split_size_or_sections)
+    else:
+        sizes = list(split_size_or_sections)
+    out, ofs = [], 0
+    for s in sizes:
+        out.append(slice_in_dim(a, ofs, ofs + s, dim))
+        ofs += s
+    return tuple(out)
+
+
+def chunk(a: TensorProxy, chunks: int, dim=0):
+    dim = canonicalize_dim(a.ndim, dim)
+    size = -(-a.shape[dim] // chunks)
+    return split(a, size, dim)
+
+
+def cat(tensors, dim=0):
+    tensors = [t for t in tensors]
+    dim = canonicalize_dim(tensors[0].ndim, pyval(dim))
+    dt = _result_dtype(*tensors)
+    tensors = [maybe_convert_to_dtype(t, dt) for t in tensors]
+    return prims.cat(tensors, dim)
+
+
+def stack(tensors, dim=0):
+    tensors = [unsqueeze(t, dim) for t in tensors]
+    return cat(tensors, dim)
+
+
+def expand(a: TensorProxy, shape) -> TensorProxy:
+    shape = tuple(int(pyval(s)) for s in shape)
+    off = len(shape) - a.ndim
+    shape = tuple(a.shape[i - off] if s == -1 else s for i, s in enumerate(shape))
+    return expand_to(a, shape)
+
+
+def flip(a: TensorProxy, dims) -> TensorProxy:
+    dims = canonicalize_dims(a.ndim, tuple(dims))
+    return prims.flip(a, dims)
+
+
+def pad(a: TensorProxy, padding_value, padding_config) -> TensorProxy:
+    return prims.pad(a, padding_value, tuple(padding_config))
+
+
+def movedim(a: TensorProxy, source, destination) -> TensorProxy:
+    src = [canonicalize_dim(a.ndim, s) for s in (source if isinstance(source, (tuple, list)) else (source,))]
+    dst = [canonicalize_dim(a.ndim, d) for d in (destination if isinstance(destination, (tuple, list)) else (destination,))]
+    perm = [d for d in range(a.ndim) if d not in src]
+    for d, s in sorted(zip(dst, src)):
+        perm.insert(d, s)
+    return permute(a, perm)
+
+
+# indexing -------------------------------------------------------------------
+
+
+def getitem(a: TensorProxy, key):
+    """Basic indexing (int/slice/None/Ellipsis/tensor) — the subset models use."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    # expand Ellipsis
+    n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)
+    if Ellipsis in key:
+        i = key.index(Ellipsis)
+        key = key[:i] + (slice(None),) * (a.ndim - n_specified) + key[i + 1 :]
+    else:
+        key = key + (slice(None),) * (a.ndim - n_specified)
+
+    # advanced: single integer-tensor index
+    tensor_idxs = [i for i, k in enumerate(key) if isinstance(k, TensorProxy)]
+    if tensor_idxs:
+        check(len(tensor_idxs) == 1, lambda: "multiple tensor indices not supported yet")
+        ti = tensor_idxs[0]
+        pre = key[:ti]
+        check(all(k == slice(None) for k in pre), lambda: "tensor index after nontrivial basic index unsupported")
+        idx = key[ti]
+        if idx.dtype.is_bool:
+            raise NotImplementedError("boolean mask indexing not supported yet")
+        out = prims.take(a, idx, ti)
+        rest = key[ti + 1 :]
+        check(all(k == slice(None) for k in rest), lambda: "mixed advanced indexing unsupported")
+        return out
+
+    starts, limits, strides = [], [], []
+    squeeze_dims = []
+    unsqueeze_positions = []
+    dim = 0
+    out_pos = 0
+    for k in key:
+        if k is None:
+            unsqueeze_positions.append(out_pos)
+            out_pos += 1
+            continue
+        if isinstance(k, (int, NumberProxy)):
+            kv = canonicalize_dim(a.shape[dim], int(pyval(k))) if a.shape[dim] > 0 else 0
+            starts.append(kv)
+            limits.append(kv + 1)
+            strides.append(1)
+            squeeze_dims.append(dim)
+            dim += 1
+            continue
+        if isinstance(k, slice):
+            start, stop, step = k.indices(a.shape[dim])
+            check(step > 0, lambda: "negative slice steps unsupported")
+            starts.append(start)
+            limits.append(stop)
+            strides.append(step)
+            dim += 1
+            out_pos += 1
+            continue
+        raise NotImplementedError(f"unsupported index element {k!r}")
+    out = a
+    if starts and (tuple(starts) != (0,) * a.ndim or tuple(limits) != a.shape or set(strides) != {1}):
+        out = prims.slice_prim(a, tuple(starts), tuple(limits), tuple(strides))
+    if squeeze_dims:
+        out = prims.squeeze(out, tuple(squeeze_dims))
+    for pos in unsqueeze_positions:
+        out = unsqueeze(out, pos)
+    return out
+
+
+def take(a, indices, dim):
+    return prims.take(a, indices, dim)
+
+
+def take_along_axis(a, indices, dim):
+    dim = canonicalize_dim(a.ndim, dim)
+    return prims.take_along_axis(a, indices, dim)
+
+
+def index_add(a, indices, value, dim):
+    return prims.index_add(a, indices, value, canonicalize_dim(a.ndim, dim))
+
+
+def scatter_add(a, indices, value, dim):
+    return prims.scatter_add(a, indices, value, canonicalize_dim(a.ndim, dim))
+
+
+# reductions -----------------------------------------------------------------
+
+
+def _reduction_dims(a, dim):
+    if dim is None:
+        return tuple(range(a.ndim))
+    if isinstance(dim, (int, NumberProxy)):
+        dim = (int(pyval(dim)),)
+    return canonicalize_dims(a.ndim, tuple(int(pyval(d)) for d in dim))
+
+
+def _maybe_keepdim(out, a, dims, keepdim):
+    if not keepdim:
+        return out
+    shape = tuple(1 if i in dims else s for i, s in enumerate(a.shape))
+    return reshape(out, shape)
+
+
+def sum_(a, dim=None, keepdim=False, *, dtype=None):
+    dims = _reduction_dims(a, dim)
+    if dtype is None and (a.dtype.is_bool or (a.dtype.is_int and a.dtype.bytes < 8)):
+        dtype = dtypes.int64
+    out = prims.sum_prim(a, dims, output_dtype=dtypes.to_dtype(dtype) if dtype else None)
+    return _maybe_keepdim(out, a, dims, keepdim)
+
+
+def mean(a, dim=None, keepdim=False, *, dtype=None):
+    dims = _reduction_dims(a, dim)
+    count = 1
+    for d in dims:
+        count *= a.shape[d]
+    if dtype is None:
+        dtype = a.dtype if a.dtype.is_inexact else dtypes.float32
+    s = sum_(maybe_convert_to_dtype(a, dtypes.to_dtype(dtype)), dim, keepdim)
+    return true_divide(s, count)
+
+
+def var(a, dim=None, keepdim=False, *, correction=1):
+    dims = _reduction_dims(a, dim)
+    count = 1
+    for d in dims:
+        count *= a.shape[d]
+    m = mean(a, dim, keepdim=True)
+    centered = sub(a, m)
+    sq = mul(centered, centered)
+    s = sum_(sq, dim, keepdim)
+    denom = max(0, count - correction)
+    return true_divide(s, denom)
+
+
+def var_mean(a, dim=None, keepdim=False, *, correction=1):
+    return var(a, dim, keepdim, correction=correction), mean(a, dim, keepdim)
+
+
+def amax(a, dim=None, keepdim=False):
+    dims = _reduction_dims(a, dim)
+    out = prims.amax(a, dims)
+    return _maybe_keepdim(out, a, dims, keepdim)
+
+
+def amin(a, dim=None, keepdim=False):
+    dims = _reduction_dims(a, dim)
+    out = prims.amin(a, dims)
+    return _maybe_keepdim(out, a, dims, keepdim)
+
+
+def argmax(a, dim=None, keepdim=False):
+    out = prims.argmax(a, dim)
+    if dim is not None and keepdim:
+        return _maybe_keepdim(out, a, (canonicalize_dim(a.ndim, pyval(dim)),), keepdim)
+    return out
+
+
+def argmin(a, dim=None, keepdim=False):
+    out = prims.argmin(a, dim)
+    if dim is not None and keepdim:
+        return _maybe_keepdim(out, a, (canonicalize_dim(a.ndim, pyval(dim)),), keepdim)
+    return out
+
+
+def prod(a, dim=None, keepdim=False):
+    dims = _reduction_dims(a, dim)
+    out = prims.prod_prim(a, dims)
+    return _maybe_keepdim(out, a, dims, keepdim)
+
+
+def any_(a, dim=None, keepdim=False):
+    dims = _reduction_dims(a, dim)
+    out = prims.any_prim(to_bool(a), dims)
+    return _maybe_keepdim(out, a, dims, keepdim)
+
+
+def all_(a, dim=None, keepdim=False):
+    return prims.logical_not(any_(prims.logical_not(to_bool(a)), dim, keepdim))
+
+
+def cumsum(a, dim):
+    return prims.cumsum(a, canonicalize_dim(a.ndim, dim))

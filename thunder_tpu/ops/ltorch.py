@@ -1,0 +1,906 @@
+"""Torch-like operation namespace: the user-facing symbol layer.
+
+Counterpart of reference thunder/torch/__init__.py:153 (~345 @torchsymbol
+definitions). Each op here is a composite Symbol whose meta decomposes into
+clang helpers → prims, giving the hierarchical bsym IR that executors claim at
+whatever level they support (Pallas claims `sdpa`/`cross_entropy`/`rms_norm`
+whole; XLA fusion consumes the flattened prims). Tensor methods on TensorProxy
+resolve here through the method registry (reference routes via langctx,
+thunder/core/langctxs.py)."""
+from __future__ import annotations
+
+import builtins
+import math
+from numbers import Number
+from typing import Optional, Sequence
+
+from ..core import dtypes, prims
+from ..core.baseutils import canonicalize_dim, check
+from ..core.proxies import NumberProxy, TensorProxy, pyval, register_method
+from ..core.symbol import OpTags, Symbol
+from . import clang
+
+_torch_symbols: dict[str, Symbol] = {}
+
+
+def torchsymbol(*, name: str, method_names: Sequence[str] = (), id: str | None = None, tags=()):
+    """Create a composite Symbol and register tensor methods for it."""
+
+    def decorator(meta):
+        sym = Symbol(name, meta, id=id or f"torch.{name}", module="ltorch", tags=tags)
+        _torch_symbols[sym.id] = sym
+        for m in method_names:
+            register_method(m, sym)
+        return sym
+
+    return decorator
+
+
+def get_symbol(id: str) -> Symbol:
+    return _torch_symbols[id]
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="add", method_names=("add",))
+def add(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        b = clang.mul(b, alpha)
+    return clang.add(a, b)
+
+
+@torchsymbol(name="sub", method_names=("sub",))
+def sub(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        b = clang.mul(b, alpha)
+    return clang.sub(a, b)
+
+
+@torchsymbol(name="mul", method_names=("mul",))
+def mul(a, b):
+    return clang.mul(a, b)
+
+
+@torchsymbol(name="div", method_names=("div", "true_divide"))
+def div(a, b):
+    return clang.true_divide(a, b)
+
+
+@torchsymbol(name="floor_divide", method_names=("floor_divide",))
+def floor_divide(a, b):
+    return clang.floor_divide(a, b)
+
+
+@torchsymbol(name="pow", method_names=("pow",))
+def pow(a, b):
+    return clang.pow_(a, b)
+
+
+@torchsymbol(name="remainder", method_names=("remainder",))
+def remainder(a, b):
+    return clang.remainder(a, b)
+
+
+@torchsymbol(name="fmod", method_names=("fmod",))
+def fmod(a, b):
+    return clang.fmod(a, b)
+
+
+@torchsymbol(name="maximum", method_names=("maximum",))
+def maximum(a, b):
+    return clang.maximum(a, b)
+
+
+@torchsymbol(name="minimum", method_names=("minimum",))
+def minimum(a, b):
+    return clang.minimum(a, b)
+
+
+@torchsymbol(name="atan2", method_names=("atan2",))
+def atan2(a, b):
+    return clang.atan2(a, b)
+
+
+@torchsymbol(name="eq", method_names=("eq",))
+def eq(a, b):
+    return clang.eq(a, b)
+
+
+@torchsymbol(name="ne", method_names=("ne",))
+def ne(a, b):
+    return clang.ne(a, b)
+
+
+@torchsymbol(name="lt", method_names=("lt",))
+def lt(a, b):
+    return clang.lt(a, b)
+
+
+@torchsymbol(name="le", method_names=("le",))
+def le(a, b):
+    return clang.le(a, b)
+
+
+@torchsymbol(name="gt", method_names=("gt",))
+def gt(a, b):
+    return clang.gt(a, b)
+
+
+@torchsymbol(name="ge", method_names=("ge",))
+def ge(a, b):
+    return clang.ge(a, b)
+
+
+@torchsymbol(name="bitwise_and", method_names=("bitwise_and",))
+def bitwise_and(a, b):
+    return clang.bitwise_and(a, b)
+
+
+@torchsymbol(name="bitwise_or", method_names=("bitwise_or",))
+def bitwise_or(a, b):
+    return clang.bitwise_or(a, b)
+
+
+@torchsymbol(name="bitwise_xor", method_names=("bitwise_xor",))
+def bitwise_xor(a, b):
+    return clang.bitwise_xor(a, b)
+
+
+@torchsymbol(name="logical_and", method_names=("logical_and",))
+def logical_and(a, b):
+    return clang.logical_and(a, b)
+
+
+@torchsymbol(name="logical_or", method_names=("logical_or",))
+def logical_or(a, b):
+    return clang.logical_or(a, b)
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+
+
+def _unary(name, prim, method_names=None, int_to_float=False):
+    def meta(a):
+        if int_to_float and isinstance(a, TensorProxy) and not a.dtype.is_inexact:
+            a = clang.maybe_convert_to_dtype(a, dtypes.float32)
+        return prim(a)
+
+    meta.__name__ = name
+    sym = Symbol(name, meta, id=f"torch.{name}", module="ltorch")
+    _torch_symbols[sym.id] = sym
+    for m in method_names or (name,):
+        register_method(m, sym)
+    return sym
+
+
+abs = _unary("abs", prims.abs)
+neg = _unary("neg", prims.neg)
+exp = _unary("exp", prims.exp, int_to_float=True)
+exp2 = _unary("exp2", prims.exp2, int_to_float=True)
+expm1 = _unary("expm1", prims.expm1, int_to_float=True)
+log = _unary("log", prims.log, int_to_float=True)
+log1p = _unary("log1p", prims.log1p, int_to_float=True)
+log2 = _unary("log2", prims.log2, int_to_float=True)
+sqrt = _unary("sqrt", prims.sqrt, int_to_float=True)
+rsqrt = _unary("rsqrt", prims.rsqrt, int_to_float=True)
+sin = _unary("sin", prims.sin, int_to_float=True)
+cos = _unary("cos", prims.cos, int_to_float=True)
+tan = _unary("tan", prims.tan, int_to_float=True)
+tanh = _unary("tanh", prims.tanh, int_to_float=True)
+asin = _unary("asin", prims.asin, int_to_float=True)
+acos = _unary("acos", prims.acos, int_to_float=True)
+atan = _unary("atan", prims.atan, int_to_float=True)
+sinh = _unary("sinh", prims.sinh, int_to_float=True)
+cosh = _unary("cosh", prims.cosh, int_to_float=True)
+erf = _unary("erf", prims.erf, int_to_float=True)
+erfc = _unary("erfc", prims.erfc, int_to_float=True)
+floor = _unary("floor", prims.floor)
+ceil = _unary("ceil", prims.ceil)
+round = _unary("round", prims.round)
+trunc = _unary("trunc", prims.trunc)
+sign = _unary("sign", prims.sign)
+isfinite = _unary("isfinite", prims.isfinite)
+isnan = _unary("isnan", prims.isnan)
+isinf = _unary("isinf", prims.isinf)
+reciprocal = _unary("reciprocal", prims.reciprocal, int_to_float=True)
+logical_not = _unary("logical_not", prims.logical_not)
+bitwise_not = _unary("bitwise_not", prims.bitwise_not)
+
+
+@torchsymbol(name="sigmoid", method_names=("sigmoid",))
+def sigmoid(a):
+    if not a.dtype.is_inexact:
+        a = clang.maybe_convert_to_dtype(a, dtypes.float32)
+    return clang.true_divide(1.0, clang.add(1.0, prims.exp(prims.neg(a))))
+
+
+@torchsymbol(name="relu", method_names=("relu",))
+def relu(a):
+    return clang.maximum(a, 0)
+
+
+@torchsymbol(name="relu6")
+def relu6(a):
+    return clang.minimum(clang.maximum(a, 0), 6)
+
+
+@torchsymbol(name="leaky_relu")
+def leaky_relu(a, negative_slope=0.01):
+    return clang.where(clang.gt(a, 0), a, clang.mul(a, negative_slope))
+
+
+@torchsymbol(name="gelu", id="torch.gelu")
+def gelu(a, approximate: str = "none"):
+    if approximate == "tanh":
+        inner = clang.mul(
+            math.sqrt(2.0 / math.pi), clang.add(a, clang.mul(0.044715, clang.mul(a, clang.mul(a, a))))
+        )
+        return clang.mul(clang.mul(0.5, a), clang.add(1.0, prims.tanh(inner)))
+    return clang.mul(clang.mul(0.5, a), clang.add(1.0, prims.erf(clang.mul(a, 1.0 / math.sqrt(2.0)))))
+
+
+@torchsymbol(name="silu")
+def silu(a):
+    return clang.mul(a, clang.true_divide(1.0, clang.add(1.0, prims.exp(prims.neg(a)))))
+
+
+@torchsymbol(name="softplus")
+def softplus(a, beta=1.0, threshold=20.0):
+    scaled = clang.mul(a, beta)
+    sp = clang.true_divide(prims.log1p(prims.exp(scaled)), beta)
+    return clang.where(clang.gt(scaled, threshold), a, sp)
+
+
+@torchsymbol(name="mish")
+def mish(a):
+    return clang.mul(a, prims.tanh(prims.log1p(prims.exp(a))))
+
+
+@torchsymbol(name="clamp", method_names=("clamp", "clip"))
+def clamp(a, min=None, max=None):
+    if min is not None:
+        a = clang.maximum(a, min)
+    if max is not None:
+        a = clang.minimum(a, max)
+    return a
+
+
+@torchsymbol(name="masked_fill", method_names=("masked_fill",))
+def masked_fill(a, mask, value):
+    return clang.where(mask, value, a)
+
+
+@torchsymbol(name="where")
+def where(pred, a, b):
+    return clang.where(pred, a, b)
+
+
+@torchsymbol(name="tril", method_names=("tril",))
+def tril(a, diagonal=0):
+    rows, cols = a.shape[-2], a.shape[-1]
+    r = clang.unsqueeze(prims.iota(rows, dtype=dtypes.int32, device=a.device), 1)
+    c = clang.unsqueeze(prims.iota(cols, dtype=dtypes.int32, device=a.device), 0)
+    mask = clang.ge(clang.sub(clang.add(r, diagonal), c), 0)
+    return clang.where(mask, a, clang.full_like(a, 0))
+
+
+@torchsymbol(name="triu", method_names=("triu",))
+def triu(a, diagonal=0):
+    rows, cols = a.shape[-2], a.shape[-1]
+    r = clang.unsqueeze(prims.iota(rows, dtype=dtypes.int32, device=a.device), 1)
+    c = clang.unsqueeze(prims.iota(cols, dtype=dtypes.int32, device=a.device), 0)
+    mask = clang.ge(clang.sub(c, clang.add(r, diagonal)), 0)
+    return clang.where(mask, a, clang.full_like(a, 0))
+
+
+# ---------------------------------------------------------------------------
+# dtype/device conversion
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="to", method_names=("to",))
+def to(a, dtype_or_device=None, *, dtype=None, device=None):
+    from ..core.devices import Device
+
+    if isinstance(dtype_or_device, (dtypes.dtype,)) or dtype_or_device in (float, int, bool):
+        dtype = dtype_or_device
+    elif dtype_or_device is not None:
+        device = dtype_or_device
+    out = a
+    if dtype is not None and dtypes.to_dtype(dtype) != a.dtype:
+        out = prims.convert_element_type(out, dtypes.to_dtype(dtype))
+    if device is not None:
+        out = prims.device_put(out, device)
+    return out
+
+
+@torchsymbol(name="type_as", method_names=("type_as",))
+def type_as(a, b):
+    return prims.convert_element_type(a, b.dtype) if a.dtype != b.dtype else a
+
+
+for _n, _d in (("float", dtypes.float32), ("double", dtypes.float64), ("half", dtypes.float16),
+               ("bfloat16", dtypes.bfloat16), ("long", dtypes.int64), ("int", dtypes.int32),
+               ("bool", dtypes.bool8)):
+    def _mk(dt):
+        def meta(a):
+            return prims.convert_element_type(a, dt) if a.dtype != dt else a
+        return meta
+    _s = Symbol(_n, _mk(_d), id=f"torch.{_n}", module="ltorch")
+    _torch_symbols[_s.id] = _s
+    register_method(_n, _s)
+
+
+@torchsymbol(name="detach", method_names=("detach",))
+def detach(a):
+    return prims.stop_gradient(a)
+
+
+@torchsymbol(name="contiguous", method_names=("contiguous",))
+def contiguous(a):
+    return a
+
+
+# ---------------------------------------------------------------------------
+# factories
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="full")
+def full(shape, fill_value, *, device=None, dtype=None):
+    return clang.full(shape, pyval(fill_value), device=device, dtype=dtype)
+
+
+@torchsymbol(name="zeros")
+def zeros(*shape, device=None, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return clang.full(shape, 0.0 if dtype is None else 0, device=device, dtype=dtype or dtypes.float32)
+
+
+@torchsymbol(name="ones")
+def ones(*shape, device=None, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return clang.full(shape, 1.0 if dtype is None else 1, device=device, dtype=dtype or dtypes.float32)
+
+
+@torchsymbol(name="zeros_like")
+def zeros_like(a, *, device=None, dtype=None):
+    return clang.full_like(a, 0, device=device, dtype=dtype)
+
+
+@torchsymbol(name="ones_like")
+def ones_like(a, *, device=None, dtype=None):
+    return clang.full_like(a, 1, device=device, dtype=dtype)
+
+
+@torchsymbol(name="full_like")
+def full_like(a, fill_value, *, device=None, dtype=None):
+    return clang.full_like(a, pyval(fill_value), device=device, dtype=dtype)
+
+
+@torchsymbol(name="arange")
+def arange(start, end=None, step=1, *, device=None, dtype=None):
+    return clang.arange(start, end, step, device=device, dtype=dtype)
+
+
+@torchsymbol(name="linspace")
+def linspace(start, end, steps, *, device=None, dtype=None):
+    dtype = dtypes.to_dtype(dtype) if dtype else dtypes.float32
+    i = prims.iota(steps, dtype=dtypes.float32, device=device)
+    step = (pyval(end) - pyval(start)) / builtins.max(1, pyval(steps) - 1)
+    return clang.maybe_convert_to_dtype(clang.add(clang.mul(i, step), pyval(start)), dtype)
+
+
+@torchsymbol(name="one_hot")
+def one_hot(a, num_classes):
+    c = prims.iota(num_classes, dtype=dtypes.int64 if a.dtype.is_int else a.dtype, device=a.device)
+    expanded = clang.unsqueeze(a, -1)
+    return clang.maybe_convert_to_dtype(clang.eq(expanded, clang.expand_to(c, expanded.shape[:-1] + (num_classes,))), dtypes.int64)
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="reshape", method_names=("reshape", "view"))
+def reshape(a, *shape):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return clang.reshape(a, shape)
+
+
+@torchsymbol(name="permute", method_names=("permute",))
+def permute(a, *dims):
+    if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+        dims = tuple(dims[0])
+    return clang.permute(a, dims)
+
+
+@torchsymbol(name="transpose", method_names=("transpose", "swapaxes"))
+def transpose(a, dim0, dim1):
+    return clang.transpose(a, pyval(dim0), pyval(dim1))
+
+
+@torchsymbol(name="matrix_transpose", method_names=("matrix_transpose",))
+def matrix_transpose(a):
+    return clang.matrix_transpose(a)
+
+
+@torchsymbol(name="t", method_names=("t",))
+def t(a):
+    check(a.ndim <= 2, lambda: ".t() on >2D tensor")
+    return clang.matrix_transpose(a) if a.ndim == 2 else a
+
+
+@torchsymbol(name="unsqueeze", method_names=("unsqueeze",))
+def unsqueeze(a, dim):
+    return clang.unsqueeze(a, pyval(dim))
+
+
+@torchsymbol(name="squeeze", method_names=("squeeze",))
+def squeeze(a, dim=None):
+    return clang.squeeze(a, dim)
+
+
+@torchsymbol(name="flatten", method_names=("flatten",))
+def flatten(a, start_dim=0, end_dim=-1):
+    return clang.flatten(a, pyval(start_dim), pyval(end_dim))
+
+
+@torchsymbol(name="expand", method_names=("expand",))
+def expand(a, *shape):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return clang.expand(a, shape)
+
+
+@torchsymbol(name="cat")
+def cat(tensors, dim=0):
+    return clang.cat(list(tensors), dim)
+
+
+@torchsymbol(name="stack")
+def stack(tensors, dim=0):
+    return clang.stack(list(tensors), dim)
+
+
+@torchsymbol(name="split", method_names=("split",))
+def split(a, split_size_or_sections, dim=0):
+    return clang.split(a, split_size_or_sections, pyval(dim))
+
+
+@torchsymbol(name="chunk", method_names=("chunk",))
+def chunk(a, chunks, dim=0):
+    return clang.chunk(a, pyval(chunks), pyval(dim))
+
+
+@torchsymbol(name="flip", method_names=("flip",))
+def flip(a, dims):
+    return clang.flip(a, dims)
+
+
+@torchsymbol(name="movedim", method_names=("movedim",))
+def movedim(a, source, destination):
+    return clang.movedim(a, source, destination)
+
+
+@torchsymbol(name="repeat", method_names=("repeat",))
+def repeat(a, *sizes):
+    if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+        sizes = tuple(sizes[0])
+    out = a
+    # prepend dims
+    while out.ndim < len(sizes):
+        out = clang.unsqueeze(out, 0)
+    tiles = []
+    for i, s in enumerate(sizes):
+        if s > 1:
+            out = clang.cat([out] * s, i)
+    return out
+
+
+@torchsymbol(name="getitem", method_names=("getitem",))
+def getitem(a, key):
+    return clang.getitem(a, key)
+
+
+@torchsymbol(name="index_select", method_names=("index_select",))
+def index_select(a, dim, index):
+    return clang.take(a, index, pyval(dim))
+
+
+@torchsymbol(name="gather", method_names=("gather",))
+def gather(a, dim, index):
+    return clang.take_along_axis(a, index, pyval(dim))
+
+
+@torchsymbol(name="take_along_dim", method_names=("take_along_dim",))
+def take_along_dim(a, indices, dim):
+    return clang.take_along_axis(a, indices, pyval(dim))
+
+
+@torchsymbol(name="index_add", method_names=("index_add",))
+def index_add(a, dim, index, source):
+    return clang.index_add(a, index, source, pyval(dim))
+
+
+@torchsymbol(name="scatter_add", method_names=("scatter_add",))
+def scatter_add(a, dim, index, src):
+    return clang.scatter_add(a, index, src, pyval(dim))
+
+
+@torchsymbol(name="pad", id="torch.nn.functional.pad")
+def pad(a, pad_widths, mode="constant", value=0.0):
+    """torch.nn.functional.pad with the (last-dim-first) flat pad list."""
+    check(mode == "constant", lambda: f"pad mode {mode} unsupported")
+    cfg = [(0, 0, 0)] * a.ndim
+    pairs = [(pyval(pad_widths[i]), pyval(pad_widths[i + 1])) for i in range(0, len(pad_widths), 2)]
+    for i, (lo, hi) in enumerate(pairs):
+        cfg[a.ndim - 1 - i] = (lo, hi, 0)
+    return clang.pad(a, value, cfg)
+
+
+@torchsymbol(name="roll", method_names=("roll",))
+def roll(a, shifts, dims=None):
+    if dims is None:
+        flat = clang.reshape(a, (a.numel,))
+        out = roll_1d(flat, pyval(shifts))
+        return clang.reshape(out, a.shape)
+    shifts = (shifts,) if isinstance(shifts, int) else shifts
+    dims = (dims,) if isinstance(dims, int) else dims
+    out = a
+    for s, d in zip(shifts, dims):
+        d = canonicalize_dim(out.ndim, d)
+        n = out.shape[d]
+        s = pyval(s) % builtins.max(1, n)
+        if s == 0:
+            continue
+        left = clang.slice_in_dim(out, n - s, n, d)
+        right = clang.slice_in_dim(out, 0, n - s, d)
+        out = clang.cat([left, right], d)
+    return out
+
+
+def roll_1d(a, shift):
+    n = a.shape[0]
+    shift = shift % builtins.max(1, n)
+    if shift == 0:
+        return a
+    return clang.cat([clang.slice_in_dim(a, n - shift, n, 0), clang.slice_in_dim(a, 0, n - shift, 0)], 0)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="sum", method_names=("sum",))
+def sum(a, dim=None, keepdim=False, *, dtype=None):
+    return clang.sum_(a, dim, keepdim, dtype=dtype)
+
+
+@torchsymbol(name="mean", method_names=("mean",))
+def mean(a, dim=None, keepdim=False, *, dtype=None):
+    return clang.mean(a, dim, keepdim, dtype=dtype)
+
+
+@torchsymbol(name="var", method_names=("var",))
+def var(a, dim=None, keepdim=False, *, correction=1):
+    return clang.var(a, dim, keepdim, correction=correction)
+
+
+@torchsymbol(name="std", method_names=("std",))
+def std(a, dim=None, keepdim=False, *, correction=1):
+    return prims.sqrt(clang.var(a, dim, keepdim, correction=correction))
+
+
+@torchsymbol(name="var_mean")
+def var_mean(a, dim=None, keepdim=False, *, correction=1):
+    return clang.var_mean(a, dim, keepdim, correction=correction)
+
+
+@torchsymbol(name="amax", method_names=("amax",))
+def amax(a, dim=None, keepdim=False):
+    return clang.amax(a, dim, keepdim)
+
+
+@torchsymbol(name="amin", method_names=("amin",))
+def amin(a, dim=None, keepdim=False):
+    return clang.amin(a, dim, keepdim)
+
+
+@torchsymbol(name="max", method_names=("max",))
+def max(a, dim=None, keepdim=False):
+    if dim is None:
+        return clang.amax(a, None, False)
+    values = clang.amax(a, dim, keepdim)
+    indices = clang.argmax(a, dim, keepdim)
+    return values, indices
+
+
+@torchsymbol(name="min", method_names=("min",))
+def min(a, dim=None, keepdim=False):
+    if dim is None:
+        return clang.amin(a, None, False)
+    values = clang.amin(a, dim, keepdim)
+    indices = clang.argmin(a, dim, keepdim)
+    return values, indices
+
+
+@torchsymbol(name="argmax", method_names=("argmax",))
+def argmax(a, dim=None, keepdim=False):
+    return clang.argmax(a, dim, keepdim)
+
+
+@torchsymbol(name="argmin", method_names=("argmin",))
+def argmin(a, dim=None, keepdim=False):
+    return clang.argmin(a, dim, keepdim)
+
+
+@torchsymbol(name="prod", method_names=("prod",))
+def prod(a, dim=None, keepdim=False):
+    return clang.prod(a, dim, keepdim)
+
+
+@torchsymbol(name="any", method_names=("any",))
+def any(a, dim=None, keepdim=False):
+    return clang.any_(a, dim, keepdim)
+
+
+@torchsymbol(name="all", method_names=("all",))
+def all(a, dim=None, keepdim=False):
+    return clang.all_(a, dim, keepdim)
+
+
+@torchsymbol(name="cumsum", method_names=("cumsum",))
+def cumsum(a, dim):
+    return clang.cumsum(a, pyval(dim))
+
+
+@torchsymbol(name="topk", method_names=("topk",))
+def topk(a, k, dim=-1):
+    return prims.topk(a, pyval(k), pyval(dim))
+
+
+@torchsymbol(name="argsort", method_names=("argsort",))
+def argsort(a, dim=-1, descending=False):
+    return prims.argsort(a, canonicalize_dim(a.ndim, pyval(dim)), descending)
+
+
+@torchsymbol(name="sort", method_names=("sort",))
+def sort(a, dim=-1, descending=False):
+    d = canonicalize_dim(a.ndim, pyval(dim))
+    return prims.sort(a, d, descending), prims.argsort(a, d, descending)
+
+
+@torchsymbol(name="softmax", method_names=("softmax",), id="torch.softmax")
+def softmax(a, dim=-1, *, dtype=None):
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, dtypes.to_dtype(dtype))
+    elif not a.dtype.is_inexact:
+        a = clang.maybe_convert_to_dtype(a, dtypes.float32)
+    m = clang.amax(a, dim, keepdim=True)
+    e = prims.exp(clang.sub(a, m))
+    return clang.true_divide(e, clang.sum_(e, dim, keepdim=True))
+
+
+@torchsymbol(name="log_softmax", method_names=("log_softmax",), id="torch.log_softmax")
+def log_softmax(a, dim=-1, *, dtype=None):
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, dtypes.to_dtype(dtype))
+    elif not a.dtype.is_inexact:
+        a = clang.maybe_convert_to_dtype(a, dtypes.float32)
+    m = clang.amax(a, dim, keepdim=True)
+    shifted = clang.sub(a, m)
+    lse = prims.log(clang.sum_(prims.exp(shifted), dim, keepdim=True))
+    return clang.sub(shifted, lse)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra & NN ops
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="matmul", method_names=("matmul", "mm", "bmm"))
+def matmul(a, b):
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="einsum_bmm", id="torch.einsum_bmm")
+def einsum_bmm(a, b):
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="linear", id="torch.nn.functional.linear")
+def linear(a, w, bias=None):
+    out = prims.linear(a, w, bias)
+    if bias is not None:
+        out = clang.add(out, bias)
+    return out
+
+
+@torchsymbol(name="embedding", id="torch.nn.functional.embedding")
+def embedding(indices, weight):
+    return prims.embedding(indices, weight)
+
+
+@torchsymbol(name="conv2d", id="torch.nn.functional.conv2d")
+def conv2d(a, weight, bias=None, stride=(1, 1), padding=(0, 0), dilation=(1, 1), groups=1):
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    out = prims.convolution(a, weight, None, stride, padding, dilation, groups)
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, (1, bias.shape[0], 1, 1)))
+    return out
+
+
+@torchsymbol(name="conv1d", id="torch.nn.functional.conv1d")
+def conv1d(a, weight, bias=None, stride=(1,), padding=(0,), dilation=(1,), groups=1):
+    stride = (stride,) if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation,) if isinstance(dilation, int) else tuple(dilation)
+    out = prims.convolution(a, weight, None, stride, padding, dilation, groups)
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, (1, bias.shape[0], 1)))
+    return out
+
+
+@torchsymbol(name="layer_norm", id="torch.nn.functional.layer_norm")
+def layer_norm(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+    ndims = len(normalized_shape)
+    dims = tuple(range(a.ndim - ndims, a.ndim))
+    compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
+    m = clang.mean(compute, dims, keepdim=True)
+    centered = clang.sub(compute, m)
+    v = clang.mean(clang.mul(centered, centered), dims, keepdim=True)
+    out = clang.mul(centered, prims.rsqrt(clang.add(v, eps)))
+    out = clang.maybe_convert_to_dtype(out, a.dtype)
+    if weight is not None:
+        out = clang.mul(out, weight)
+    if bias is not None:
+        out = clang.add(out, bias)
+    return out
+
+
+@torchsymbol(name="rms_norm", id="torch.nn.functional.rms_norm")
+def rms_norm(a, normalized_shape, weight=None, eps=1e-6):
+    ndims = len(normalized_shape)
+    dims = tuple(range(a.ndim - ndims, a.ndim))
+    compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
+    ms = clang.mean(clang.mul(compute, compute), dims, keepdim=True)
+    out = clang.mul(compute, prims.rsqrt(clang.add(ms, eps)))
+    out = clang.maybe_convert_to_dtype(out, a.dtype)
+    if weight is not None:
+        out = clang.mul(out, weight)
+    return out
+
+
+@torchsymbol(name="sdpa", id="torch.nn.functional.scaled_dot_product_attention")
+def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    """Scaled dot-product attention (composite; Pallas flash-attention executor
+    claims this symbol whole — reference analog: sdpaex/cudnnex claiming,
+    thunder/executors/sdpaex.py:1)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kt = clang.matrix_transpose(k)
+    scores = clang.mul(prims.matmul(q, kt), scale)
+    if is_causal:
+        Lq, Lk = q.shape[-2], k.shape[-2]
+        r = clang.unsqueeze(prims.iota(Lq, dtype=dtypes.int32, device=q.device), 1)
+        c = clang.unsqueeze(prims.iota(Lk, dtype=dtypes.int32, device=q.device), 0)
+        causal = clang.ge(clang.add(r, Lk - Lq), c)
+        scores = clang.where(causal, scores, float("-inf"))
+    if attn_mask is not None:
+        if attn_mask.dtype.is_bool:
+            scores = clang.where(attn_mask, scores, float("-inf"))
+        else:
+            scores = clang.add(scores, attn_mask)
+    probs = softmax(scores, -1)
+    probs = clang.maybe_convert_to_dtype(probs, v.dtype)
+    return prims.matmul(probs, v)
+
+
+@torchsymbol(name="cross_entropy", id="torch.nn.functional.cross_entropy")
+def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    """Composite cross-entropy over class dim 1 / last for 2D (logits (N, C)).
+
+    Pallas fused cross-entropy claims this whole (reference analog: apex/triton
+    cross-entropy executors, thunder/executors/triton_crossentropy_impl.py)."""
+    check(logits.ndim == 2, lambda: "cross_entropy currently expects (N, C) logits")
+    lsm = log_softmax(logits, 1)
+    n, c = logits.shape
+    tgt = clang.unsqueeze(target, 1)
+    picked = clang.squeeze(clang.take_along_axis(lsm, tgt, 1), 1)
+    nll = prims.neg(picked)
+    if label_smoothing > 0.0:
+        smooth = prims.neg(clang.mean(lsm, 1))
+        nll = clang.add(clang.mul(nll, 1.0 - label_smoothing), clang.mul(smooth, label_smoothing))
+    valid = clang.ne(target, ignore_index)
+    nll = clang.where(valid, nll, clang.full_like(nll, 0))
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return clang.sum_(nll)
+    count = clang.sum_(clang.maybe_convert_to_dtype(valid, nll.dtype))
+    return clang.true_divide(clang.sum_(nll), count)
+
+
+@torchsymbol(name="nll_loss", id="torch.nn.functional.nll_loss")
+def nll_loss(log_probs, target, reduction="mean"):
+    tgt = clang.unsqueeze(target, 1)
+    picked = clang.squeeze(clang.take_along_axis(log_probs, tgt, 1), 1)
+    nll = prims.neg(picked)
+    if reduction == "none":
+        return nll
+    if reduction == "sum":
+        return clang.sum_(nll)
+    return clang.mean(nll)
+
+
+@torchsymbol(name="mse_loss", id="torch.nn.functional.mse_loss")
+def mse_loss(input, target, reduction="mean"):
+    d = clang.sub(input, target)
+    sq = clang.mul(d, d)
+    if reduction == "none":
+        return sq
+    if reduction == "sum":
+        return clang.sum_(sq)
+    return clang.mean(sq)
+
+
+@torchsymbol(name="dropout", id="torch.nn.functional.dropout")
+def dropout(a, p=0.5, training=True, *, key=None):
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "dropout in training mode requires an rng key (pass key= or use nn.Module rng plumbing)")
+    keep = 1.0 - p
+    mask = clang.lt(prims.uniform(a.shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    return clang.mul(clang.where(mask, a, clang.full_like(a, 0)), 1.0 / keep)
+
+
+@torchsymbol(name="grouped_mm", id="torch.grouped_mm")
+def grouped_mm(a, b, group_sizes):
+    return prims.grouped_mm(a, b, group_sizes)
+
+
+@torchsymbol(name="baddbmm", method_names=("baddbmm",))
+def baddbmm(input, batch1, batch2, *, beta=1, alpha=1):
+    out = prims.matmul(batch1, batch2)
+    if pyval(alpha) != 1:
+        out = clang.mul(out, alpha)
+    if pyval(beta) != 0:
+        out = clang.add(out, clang.mul(input, beta) if pyval(beta) != 1 else input)
+    return out
+
+
+@torchsymbol(name="addmm", method_names=("addmm",))
+def addmm(input, mat1, mat2, *, beta=1, alpha=1):
+    return baddbmm.meta(input, mat1, mat2, beta=beta, alpha=alpha)
+
+
+@torchsymbol(name="outer", method_names=("outer",))
+def outer(a, b):
+    return clang.mul(clang.unsqueeze(a, 1), clang.unsqueeze(b, 0))
+
+
+# normalization helpers used by models ---------------------------------------
+
+
+@torchsymbol(name="glu", id="torch.nn.functional.glu")
+def glu(a, dim=-1):
+    x, g = clang.chunk(a, 2, pyval(dim))
+    return clang.mul(x, sigmoid.meta(g))
+
+
+@torchsymbol(name="swiglu", id="thunder_tpu.swiglu")
+def swiglu(gate, up):
+    return clang.mul(clang.mul(gate, clang.true_divide(1.0, clang.add(1.0, prims.exp(prims.neg(gate))))), up)

@@ -1,0 +1,46 @@
+"""Plugins: string-addressable feature bundles (reference thunder/plugins/__init__.py:7-13).
+
+DDP/FSDP/TP plugins live in thunder_tpu.parallel; this module hosts the
+registry and the simple ones."""
+from __future__ import annotations
+
+
+class Plugin:
+    def setup_transforms(self, transforms: list) -> list:
+        return transforms
+
+    def setup_executors(self, executors: list) -> list:
+        return executors
+
+
+class ReduceOverhead(Plugin):
+    """On GPU this is CUDA graphs (reference thunder/plugins/__init__.py); on
+    TPU whole-trace XLA compilation already removes per-op overhead, so this
+    is a no-op kept for API parity."""
+
+
+_registry: dict[str, type] = {}
+
+
+def register_plugin(name: str, cls: type) -> None:
+    _registry[name] = cls
+
+
+register_plugin("reduce-overhead", ReduceOverhead)
+
+
+def resolve_plugin(p):
+    if isinstance(p, Plugin):
+        return p
+    if isinstance(p, str):
+        if p in _registry:
+            return _registry[p]()
+        # lazily register distributed plugins
+        from .parallel import plugins as _pp  # noqa: F401
+
+        if p in _registry:
+            return _registry[p]()
+        raise ValueError(f"unknown plugin '{p}' (known: {sorted(_registry)})")
+    if isinstance(p, type) and issubclass(p, Plugin):
+        return p()
+    raise TypeError(f"cannot resolve plugin {p!r}")

@@ -1,0 +1,70 @@
+"""Recipes: packaged compile configurations (reference thunder/core/recipe.py:53,
+thunder/recipes/base.py:52). A Recipe bundles executors + transforms + options;
+plugins add to them (see plugins.py)."""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+
+class Recipe:
+    """Base recipe: hooks to collect lookasides/transforms/executors."""
+
+    def __init__(self, *, fuser: str = "xla", show_progress: bool = False):
+        self.fuser = fuser
+        self.plugins: list = []
+
+    def setup_transforms(self) -> list:
+        return []
+
+    def setup_executors(self) -> list:
+        from .extend import get_executor
+
+        exs = []
+        try:
+            exs.append(get_executor("pallas"))
+        except LookupError:
+            pass
+        exs.append(get_executor(self.fuser if self.fuser != "none" else "jax"))
+        return exs
+
+    def setup_config(self) -> dict:
+        return {}
+
+    def add_plugins(self, plugins: Sequence) -> None:
+        self.plugins.extend(plugins)
+
+    def apply(self, fn: Callable, *, plugins=None, **kwargs):
+        from . import jit
+        from .plugins import resolve_plugin
+
+        if plugins is not None:
+            self.add_plugins([resolve_plugin(p) for p in (plugins if isinstance(plugins, (list, tuple)) else [plugins])])
+
+        transforms = self.setup_transforms()
+        executors = self.setup_executors()
+        config = self.setup_config()
+        for p in self.plugins:
+            transforms = p.setup_transforms(transforms)
+            executors = p.setup_executors(executors)
+        config.update(kwargs)
+        return jit(fn, executors=executors, transforms=transforms, **config)
+
+    @classmethod
+    def get_for_model(cls, fn) -> "Recipe":
+        return BaseRecipe()
+
+
+class BaseRecipe(Recipe):
+    pass
+
+
+def resolve_recipe(recipe, fn) -> Recipe:
+    if recipe is None or recipe == "auto":
+        return Recipe.get_for_model(fn)
+    if isinstance(recipe, Recipe):
+        return recipe
+    if isinstance(recipe, str):
+        if recipe in ("base", "default"):
+            return BaseRecipe()
+        raise ValueError(f"unknown recipe '{recipe}'")
+    raise TypeError(f"cannot resolve recipe {recipe!r}")
