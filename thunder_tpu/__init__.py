@@ -67,16 +67,17 @@ def _unwrap(x):
     return data if data is not None and hasattr(x, "requires_grad") else x
 
 
-def acquire_trace(fn: Callable, args, kwargs) -> tuple[TraceCtx, Any, list, list]:
+def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None = None) -> tuple[TraceCtx, Any, list, list]:
     """Trace fn by calling it with proxies. Returns (trace, treedef, tensor_mask, leaves)."""
     leaves, treedef = tree_flatten((args, kwargs))
     trc = TraceCtx(fn)
     proxy_leaves = []
     tensor_mask = []
     with tracectx(trc):
-        for leaf in leaves:
+        for i, leaf in enumerate(leaves):
             if _is_tensor_like(leaf):
-                p = proxy_from_jax(leaf, requires_grad=bool(getattr(leaf, "requires_grad", False)))
+                rg = bool(getattr(leaf, "requires_grad", False)) or bool(grad_mask[i] if grad_mask else False)
+                p = proxy_from_jax(leaf, requires_grad=rg)
                 proxy_leaves.append(p)
                 tensor_mask.append(True)
             else:

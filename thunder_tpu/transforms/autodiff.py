@@ -1,0 +1,1030 @@
+"""Trace-level autodiff: augmented-forward + backward trace construction.
+
+Re-design of reference thunder/transforms/autodiff.py:28 (grad transform),
+:465 (forward/backward split) and the grad-rule registry in
+thunder/core/transforms.py:668-1713. The transform walks the acquired trace
+top-down: a bsym whose symbol id has a registered VJP rule is differentiated
+at that level (this is how executor-claimed grads work — Pallas flash
+attention registers a rule for `torch.sdpa` and is never decomposed);
+otherwise the walk descends into subsymbols down to prims. The result is two
+traces — augmented forward (returns outputs + saved-for-backward) and
+backward (saved + cotangents → input grads) — each independently claimed and
+XLA-fused.
+
+Ops with no hand-written rule can fall back to `jax.vjp` of their jax impl
+(kept out of fusion regions so the vjp closure can be carried as an opaque
+saved object)."""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+from ..core import dtypes, prims
+from ..core.prims import PrimIDs
+from ..core.proxies import NumberProxy, Proxy, TensorProxy, variableify
+from ..core.symbol import BoundSymbol, OpTags, Symbol
+from ..core.trace import TraceCtx, from_trace, tracectx
+from ..core.transform_common import dce
+from ..ops import clang
+
+
+class VJPResult(NamedTuple):
+    out: Any
+    residuals: tuple
+
+
+augmented_forward_impls: dict[Any, Callable] = {}
+backward_impls: dict[Any, Callable] = {}
+
+
+def register_augmented_forward(sym_id):
+    def deco(fn):
+        augmented_forward_impls[sym_id] = fn
+        return fn
+
+    return deco
+
+
+def register_backward(sym_id):
+    def deco(fn):
+        backward_impls[sym_id] = fn
+        return fn
+
+    return deco
+
+
+def register_grad(sym_id, aug_fwd, bwd):
+    augmented_forward_impls[sym_id] = aug_fwd
+    backward_impls[sym_id] = bwd
+
+
+def has_grad_rule(sym_id) -> bool:
+    return sym_id in augmented_forward_impls
+
+
+# ops that fall back to jax.vjp of their jax impl (op-by-op, unfused)
+JAX_VJP_FALLBACK: set = {PrimIDs.CONVOLUTION, PrimIDs.GROUPED_MM, PrimIDs.ATAN2, PrimIDs.CUMSUM}
+
+
+# ---------------------------------------------------------------------------
+# helpers used inside rules
+# ---------------------------------------------------------------------------
+
+
+def _sum_to_shape(g: TensorProxy, shape: tuple) -> TensorProxy:
+    """Reduce a broadcasted gradient back to `shape`."""
+    if tuple(g.shape) == tuple(shape):
+        return g
+    # sum leading extra dims
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = prims.sum_prim(g, tuple(range(extra)))
+    # sum dims that were 1
+    dims = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if dims:
+        g = prims.sum_prim(g, dims)
+        # restore kept dims
+        new_shape = tuple(1 if i in dims else s for i, s in enumerate(shape))
+        g = prims.reshape(g, new_shape)
+    return g
+
+
+def _zeros_like(t: TensorProxy) -> TensorProxy:
+    return clang.full_like(t, 0)
+
+
+# ---------------------------------------------------------------------------
+# elementwise rules
+# ---------------------------------------------------------------------------
+
+
+register_grad(PrimIDs.ADD, lambda a, b: VJPResult(prims.add(a, b), ()),
+              lambda g: (g, g))
+register_grad(PrimIDs.SUB, lambda a, b: VJPResult(prims.sub(a, b), ()),
+              lambda g: (g, prims.neg(g)))
+
+
+@register_augmented_forward(PrimIDs.MUL)
+def _mul_aug(a, b):
+    return VJPResult(prims.mul(a, b), (a, b))
+
+
+@register_backward(PrimIDs.MUL)
+def _mul_bwd(a, b, g):
+    return prims.mul(g, b), prims.mul(g, a)
+
+
+@register_augmented_forward(PrimIDs.DIV)
+def _div_aug(a, b):
+    out = prims.div(a, b)
+    return VJPResult(out, (a, b))
+
+
+@register_backward(PrimIDs.DIV)
+def _div_bwd(a, b, g):
+    ga = prims.div(g, b)
+    gb = prims.neg(prims.div(prims.mul(g, prims.div(a, b)), b))
+    return ga, gb
+
+
+@register_augmented_forward(PrimIDs.POW)
+def _pow_aug(a, b):
+    out = prims.pow(a, b)
+    return VJPResult(out, (a, b, out))
+
+
+@register_backward(PrimIDs.POW)
+def _pow_bwd(a, b, out, g):
+    one = clang.full_like(b, 1)
+    ga = prims.mul(g, prims.mul(b, prims.pow(a, prims.sub(b, one))))
+    # d/db a^b = out * log(a); guard log of nonpositive
+    safe_a = prims.maximum(a, clang.full_like(a, 1e-30))
+    gb = prims.mul(g, prims.mul(out, prims.log(safe_a)))
+    return ga, gb
+
+
+register_grad(PrimIDs.NEG, lambda a: VJPResult(prims.neg(a), ()), lambda g: prims.neg(g))
+
+
+@register_augmented_forward(PrimIDs.ABS)
+def _abs_aug(a):
+    return VJPResult(prims.abs(a), (a,))
+
+
+@register_backward(PrimIDs.ABS)
+def _abs_bwd(a, g):
+    return prims.mul(g, prims.sign(a))
+
+
+@register_augmented_forward(PrimIDs.EXP)
+def _exp_aug(a):
+    out = prims.exp(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.EXP)
+def _exp_bwd(out, g):
+    return prims.mul(g, out)
+
+
+@register_augmented_forward(PrimIDs.LOG)
+def _log_aug(a):
+    return VJPResult(prims.log(a), (a,))
+
+
+@register_backward(PrimIDs.LOG)
+def _log_bwd(a, g):
+    return prims.div(g, a)
+
+
+@register_augmented_forward(PrimIDs.LOG1P)
+def _log1p_aug(a):
+    return VJPResult(prims.log1p(a), (a,))
+
+
+@register_backward(PrimIDs.LOG1P)
+def _log1p_bwd(a, g):
+    return prims.div(g, clang.add(a, 1.0))
+
+
+@register_augmented_forward(PrimIDs.SQRT)
+def _sqrt_aug(a):
+    out = prims.sqrt(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.SQRT)
+def _sqrt_bwd(out, g):
+    return prims.div(g, prims.mul(clang.full_like(out, 2.0), out))
+
+
+@register_augmented_forward(PrimIDs.RSQRT)
+def _rsqrt_aug(a):
+    out = prims.rsqrt(a)
+    return VJPResult(out, (a, out))
+
+
+@register_backward(PrimIDs.RSQRT)
+def _rsqrt_bwd(a, out, g):
+    # d rsqrt(a) = -1/2 a^{-3/2} = -0.5 * out / a
+    return prims.mul(g, prims.mul(clang.full_like(out, -0.5), prims.div(out, a)))
+
+
+@register_augmented_forward(PrimIDs.SIN)
+def _sin_aug(a):
+    return VJPResult(prims.sin(a), (a,))
+
+
+@register_backward(PrimIDs.SIN)
+def _sin_bwd(a, g):
+    return prims.mul(g, prims.cos(a))
+
+
+@register_augmented_forward(PrimIDs.COS)
+def _cos_aug(a):
+    return VJPResult(prims.cos(a), (a,))
+
+
+@register_backward(PrimIDs.COS)
+def _cos_bwd(a, g):
+    return prims.neg(prims.mul(g, prims.sin(a)))
+
+
+@register_augmented_forward(PrimIDs.TANH)
+def _tanh_aug(a):
+    out = prims.tanh(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.TANH)
+def _tanh_bwd(out, g):
+    return prims.mul(g, prims.sub(clang.full_like(out, 1.0), prims.mul(out, out)))
+
+
+@register_augmented_forward(PrimIDs.ERF)
+def _erf_aug(a):
+    return VJPResult(prims.erf(a), (a,))
+
+
+@register_backward(PrimIDs.ERF)
+def _erf_bwd(a, g):
+    c = 2.0 / math.sqrt(math.pi)
+    return prims.mul(g, prims.mul(clang.full_like(a, c), prims.exp(prims.neg(prims.mul(a, a)))))
+
+
+@register_augmented_forward(PrimIDs.EXPM1)
+def _expm1_aug(a):
+    out = prims.expm1(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.EXPM1)
+def _expm1_bwd(out, g):
+    return prims.mul(g, clang.add(out, 1.0))
+
+
+@register_augmented_forward(PrimIDs.RECIPROCAL)
+def _recip_aug(a):
+    out = prims.reciprocal(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.RECIPROCAL)
+def _recip_bwd(out, g):
+    return prims.neg(prims.mul(g, prims.mul(out, out)))
+
+
+@register_augmented_forward(PrimIDs.MAXIMUM)
+def _maximum_aug(a, b):
+    return VJPResult(prims.maximum(a, b), (a, b))
+
+
+@register_backward(PrimIDs.MAXIMUM)
+def _maximum_bwd(a, b, g):
+    mask = prims.ge(a, b)
+    zero = _zeros_like(g)
+    return prims.where(mask, g, zero), prims.where(mask, zero, g)
+
+
+@register_augmented_forward(PrimIDs.MINIMUM)
+def _minimum_aug(a, b):
+    return VJPResult(prims.minimum(a, b), (a, b))
+
+
+@register_backward(PrimIDs.MINIMUM)
+def _minimum_bwd(a, b, g):
+    mask = prims.le(a, b)
+    zero = _zeros_like(g)
+    return prims.where(mask, g, zero), prims.where(mask, zero, g)
+
+
+@register_augmented_forward(PrimIDs.WHERE)
+def _where_aug(pred, a, b):
+    return VJPResult(prims.where(pred, a, b), (pred,))
+
+
+@register_backward(PrimIDs.WHERE)
+def _where_bwd(pred, g):
+    zero = _zeros_like(g)
+    return None, prims.where(pred, g, zero), prims.where(pred, zero, g)
+
+
+@register_augmented_forward(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _cvt_aug(a, dtype):
+    out = prims.convert_element_type(a, dtype)
+    in_dtype = a.dtype if isinstance(a, TensorProxy) else dtypes.to_dtype(type(a))
+    return VJPResult(out, (in_dtype,))
+
+
+@register_backward(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _cvt_bwd(in_dtype, g):
+    if not in_dtype.is_inexact:
+        return None
+    return prims.convert_element_type(g, in_dtype)
+
+
+register_grad(PrimIDs.STOP_GRADIENT, lambda a: VJPResult(prims.stop_gradient(a), ()), lambda g: None)
+
+
+# ---------------------------------------------------------------------------
+# shape-op rules
+# ---------------------------------------------------------------------------
+
+
+@register_augmented_forward(PrimIDs.RESHAPE)
+def _reshape_aug(a, shape):
+    return VJPResult(prims.reshape(a, shape), (a.shape,))
+
+
+@register_backward(PrimIDs.RESHAPE)
+def _reshape_bwd(in_shape, g):
+    return prims.reshape(g, in_shape)
+
+
+@register_augmented_forward(PrimIDs.TRANSPOSE)
+def _transpose_aug(a, permutation):
+    inv = tuple(sorted(range(len(permutation)), key=lambda i: permutation[i]))
+    return VJPResult(prims.transpose(a, permutation), (inv,))
+
+
+@register_backward(PrimIDs.TRANSPOSE)
+def _transpose_bwd(inv, g):
+    return prims.transpose(g, inv)
+
+
+@register_augmented_forward(PrimIDs.BROADCAST_IN_DIM)
+def _bcast_aug(a, shape, broadcast_dimensions):
+    return VJPResult(prims.broadcast_in_dim(a, shape, broadcast_dimensions), (a.shape, tuple(broadcast_dimensions)))
+
+
+@register_backward(PrimIDs.BROADCAST_IN_DIM)
+def _bcast_bwd(in_shape, bdims, g):
+    # reduce over dims not in bdims, and over bdims where input had size 1
+    reduce_dims = tuple(d for d in range(g.ndim) if d not in bdims)
+    reduce_dims += tuple(d for i, d in enumerate(bdims) if in_shape[i] == 1)
+    out = prims.sum_prim(g, reduce_dims) if reduce_dims else g
+    return prims.reshape(out, in_shape)
+
+
+@register_augmented_forward(PrimIDs.SLICE)
+def _slice_aug(a, start_indices, limit_indices, strides=None):
+    return VJPResult(
+        prims.slice_prim(a, start_indices, limit_indices, strides),
+        (a.shape, tuple(start_indices), tuple(limit_indices), tuple(strides) if strides else None),
+    )
+
+
+@register_backward(PrimIDs.SLICE)
+def _slice_bwd(in_shape, starts, limits, strides, g):
+    if strides is None:
+        strides = (1,) * len(in_shape)
+    cfg = []
+    for i, (s, l, st) in enumerate(zip(starts, limits, strides)):
+        n_out = g.shape[i]
+        hi = in_shape[i] - (s + (n_out - 1) * st + 1)
+        cfg.append((s, hi, st - 1))
+    return prims.pad(g, 0.0, tuple(cfg))
+
+
+@register_augmented_forward(PrimIDs.SQUEEZE)
+def _squeeze_aug(a, dims):
+    return VJPResult(prims.squeeze(a, dims), (a.shape,))
+
+
+@register_backward(PrimIDs.SQUEEZE)
+def _squeeze_bwd(in_shape, g):
+    return prims.reshape(g, in_shape)
+
+
+@register_augmented_forward(PrimIDs.CAT)
+def _cat_aug(tensors, dim):
+    sizes = tuple(t.shape[dim] for t in tensors)
+    return VJPResult(prims.cat(tensors, dim), (sizes, dim))
+
+
+@register_backward(PrimIDs.CAT)
+def _cat_bwd(sizes, dim, g):
+    grads = []
+    ofs = 0
+    for s in sizes:
+        grads.append(clang.slice_in_dim(g, ofs, ofs + s, dim))
+        ofs += s
+    return tuple(grads)
+
+
+@register_augmented_forward(PrimIDs.PAD)
+def _pad_aug(a, padding_value, padding_config):
+    return VJPResult(prims.pad(a, padding_value, padding_config), (a.shape, tuple(padding_config)))
+
+
+@register_backward(PrimIDs.PAD)
+def _pad_bwd(in_shape, cfg, g):
+    starts = tuple(lo for lo, _, _ in cfg)
+    strides = tuple(i + 1 for _, _, i in cfg)
+    limits = tuple(lo + (n - 1) * st + 1 for (lo, _, _), n, st in zip(cfg, in_shape, strides))
+    return prims.slice_prim(g, starts, limits, strides)
+
+
+@register_augmented_forward(PrimIDs.FLIP)
+def _flip_aug(a, dims):
+    return VJPResult(prims.flip(a, dims), (dims,))
+
+
+@register_backward(PrimIDs.FLIP)
+def _flip_bwd(dims, g):
+    return prims.flip(g, dims)
+
+
+@register_augmented_forward(PrimIDs.TAKE)
+def _take_aug(a, indices, dim):
+    return VJPResult(prims.take(a, indices, dim), (a.shape, a.dtype, indices, dim))
+
+
+@register_backward(PrimIDs.TAKE)
+def _take_bwd(in_shape, in_dtype, indices, dim, g):
+    zeros = prims.full(in_shape, 0.0, dtype=in_dtype)
+    return prims.index_add(zeros, indices, g, dim), None
+
+
+@register_augmented_forward(PrimIDs.TAKE_ALONG_AXIS)
+def _taa_aug(a, indices, dim):
+    return VJPResult(prims.take_along_axis(a, indices, dim), (a.shape, a.dtype, indices, dim))
+
+
+@register_backward(PrimIDs.TAKE_ALONG_AXIS)
+def _taa_bwd(in_shape, in_dtype, indices, dim, g):
+    zeros = prims.full(in_shape, 0.0, dtype=in_dtype)
+    return prims.scatter_add(zeros, indices, g, dim), None
+
+
+@register_augmented_forward(PrimIDs.EMBEDDING)
+def _embedding_aug(indices, weight):
+    return VJPResult(prims.embedding(indices, weight), (indices, weight.shape, weight.dtype))
+
+
+@register_backward(PrimIDs.EMBEDDING)
+def _embedding_bwd(indices, w_shape, w_dtype, g):
+    zeros = prims.full(w_shape, 0.0, dtype=w_dtype)
+    flat_idx = prims.reshape(indices, (indices.numel,)) if indices.ndim != 1 else indices
+    flat_g = prims.reshape(g, (indices.numel, w_shape[1]))
+    return None, prims.index_add(zeros, flat_idx, flat_g, 0)
+
+
+@register_augmented_forward(PrimIDs.TOPK)
+def _topk_aug(a, k, dim):
+    values, indices = prims.topk(a, k, dim)
+    return VJPResult((values, indices), (a.shape, a.dtype, indices, dim))
+
+
+@register_backward(PrimIDs.TOPK)
+def _topk_bwd(in_shape, in_dtype, indices, dim, g_values, g_indices=None):
+    zeros = prims.full(in_shape, 0.0, dtype=in_dtype)
+    return prims.scatter_add(zeros, indices, g_values, dim)
+
+
+# ---------------------------------------------------------------------------
+# reduction rules
+# ---------------------------------------------------------------------------
+
+
+@register_augmented_forward(PrimIDs.SUM)
+def _sum_aug(a, dims, *, output_dtype=None):
+    return VJPResult(prims.sum_prim(a, dims, output_dtype=output_dtype), (a.shape, tuple(dims), a.dtype))
+
+
+@register_backward(PrimIDs.SUM)
+def _sum_bwd(in_shape, dims, in_dtype, g):
+    kept = tuple(d for d in range(len(in_shape)) if d not in dims)
+    g = prims.convert_element_type(g, in_dtype) if g.dtype != in_dtype else g
+    return prims.broadcast_in_dim(g, in_shape, kept)
+
+
+@register_augmented_forward(PrimIDs.AMAX)
+def _amax_aug(a, dims):
+    out = prims.amax(a, dims)
+    return VJPResult(out, (a, out, tuple(dims)))
+
+
+def _minmax_bwd(a, out, dims, g):
+    kept = tuple(d for d in range(a.ndim) if d not in dims)
+    out_b = prims.broadcast_in_dim(out, a.shape, kept)
+    g_b = prims.broadcast_in_dim(g, a.shape, kept)
+    mask = prims.eq(a, out_b)
+    maskf = prims.convert_element_type(mask, a.dtype)
+    count = prims.sum_prim(maskf, dims)
+    count_b = prims.broadcast_in_dim(count, a.shape, kept)
+    return prims.div(prims.mul(maskf, g_b), count_b)
+
+
+@register_backward(PrimIDs.AMAX)
+def _amax_bwd(a, out, dims, g):
+    return _minmax_bwd(a, out, dims, g)
+
+
+@register_augmented_forward(PrimIDs.AMIN)
+def _amin_aug(a, dims):
+    out = prims.amin(a, dims)
+    return VJPResult(out, (a, out, tuple(dims)))
+
+
+@register_backward(PrimIDs.AMIN)
+def _amin_bwd(a, out, dims, g):
+    return _minmax_bwd(a, out, dims, g)
+
+
+# ---------------------------------------------------------------------------
+# matmul-family rules (MXU ops)
+# ---------------------------------------------------------------------------
+
+
+@register_augmented_forward(PrimIDs.MATMUL)
+def _matmul_aug(a, b):
+    return VJPResult(prims.matmul(a, b), (a, b))
+
+
+@register_backward(PrimIDs.MATMUL)
+def _matmul_bwd(a, b, g):
+    if a.ndim == 1 and b.ndim == 1:
+        return prims.mul(g_expand(g, a), b), prims.mul(g_expand(g, a), a)
+    if a.ndim == 1:
+        # (k) @ (..., k, n) -> (..., n)
+        ga = prims.matmul(b, clang.unsqueeze(g, -1))  # (..., k, 1)
+        ga = clang.squeeze(ga, -1)
+        ga = _sum_to_shape(ga, a.shape)
+        gb = prims.matmul(clang.unsqueeze(a, -1), clang.unsqueeze(g, -2))
+        gb = _sum_to_shape(gb, b.shape)
+        return ga, gb
+    if b.ndim == 1:
+        ga = prims.matmul(clang.unsqueeze(g, -1), clang.unsqueeze(b, 0))
+        ga = _sum_to_shape(ga, a.shape)
+        gb = prims.matmul(clang.matrix_transpose(a), clang.unsqueeze(g, -1))
+        gb = clang.squeeze(gb, -1)
+        gb = _sum_to_shape(gb, b.shape)
+        return ga, gb
+    ga = prims.matmul(g, clang.matrix_transpose(b))
+    gb = prims.matmul(clang.matrix_transpose(a), g)
+    return _sum_to_shape(ga, a.shape), _sum_to_shape(gb, b.shape)
+
+
+def g_expand(g, like):
+    return prims.broadcast_in_dim(g, like.shape, ()) if g.ndim == 0 else g
+
+
+@register_augmented_forward(PrimIDs.LINEAR)
+def _linear_aug(a, w, bias=None):
+    return VJPResult(prims.linear(a, w, bias), (a, w))
+
+
+@register_backward(PrimIDs.LINEAR)
+def _linear_bwd(a, w, g):
+    # a: (..., in), w: (out, in), g: (..., out)
+    ga = prims.matmul(g, w)
+    batch = 1
+    for s in a.shape[:-1]:
+        batch *= s
+    g2 = prims.reshape(g, (batch, g.shape[-1]))
+    a2 = prims.reshape(a, (batch, a.shape[-1]))
+    gw = prims.matmul(clang.matrix_transpose(g2), a2)
+    return ga, gw
+
+
+# ---------------------------------------------------------------------------
+# the transform itself
+# ---------------------------------------------------------------------------
+
+
+class TapeEntry(NamedTuple):
+    sym_id: Any
+    inputs: tuple  # mapped (aug-fwd) flat tensor input proxies
+    outputs: tuple  # mapped flat tensor output proxies
+    residuals: tuple
+    fallback_impl: Optional[Callable]
+
+
+def _flat_tensors(x) -> tuple:
+    from ..core.codeutils import flat_tensor_proxies
+
+    return tuple(flat_tensor_proxies(x))
+
+
+def _is_diff_dtype(p) -> bool:
+    return isinstance(p, TensorProxy) and p.dtype.is_inexact
+
+
+class ForwardBackwardTraces(NamedTuple):
+    forward_trace: TraceCtx
+    backward_trace: TraceCtx
+    n_saved: int
+    grad_arg_names: tuple  # names of fwd-trace args receiving grads, in order
+
+
+def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool = False) -> ForwardBackwardTraces:
+    """Build (augmented forward, backward) traces from an acquired trace."""
+    # which args get grads
+    grad_args = [
+        p
+        for p in trace.args
+        if isinstance(p, TensorProxy) and (p.requires_grad or (grad_all_inexact_args and p.dtype.is_inexact))
+    ]
+    grad_arg_names = tuple(p.name for p in grad_args)
+
+    fwd = TraceCtx(trace.fn)
+    fwd.args = trace.args
+    fwd._name = "augmented_forward"
+    for p in trace.args:
+        fwd.add_name(p.name)
+
+    env: dict[str, Any] = {p.name: p for p in trace.args}
+    diff: set[str] = set(grad_arg_names)
+    tape: list[TapeEntry] = []
+    fwd_output = None
+
+    def lookup(x):
+        if isinstance(x, Proxy):
+            return env.get(x.name, x)
+        if isinstance(x, (tuple, list)):
+            t = type(x)(lookup(e) for e in x)
+            return t
+        if isinstance(x, dict):
+            return {k: lookup(v) for k, v in x.items()}
+        return x
+
+    def map_out(old, new):
+        if isinstance(old, Proxy):
+            env[old.name] = new
+            return
+        if isinstance(old, (tuple, list)) and isinstance(new, (tuple, list)):
+            for o, n in zip(old, new):
+                map_out(o, n)
+            return
+        if isinstance(old, dict) and isinstance(new, dict):
+            for k in old:
+                map_out(old[k], new[k])
+
+    def process(bsym: BoundSymbol):
+        nonlocal fwd_output
+        if bsym.sym.id == PrimIDs.RETURN:
+            fwd_output = lookup(bsym.args[0] if len(bsym.args) == 1 else bsym.args)
+            return
+        if bsym.sym.id in (PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL):
+            return
+        margs = lookup(bsym.args)
+        mkwargs = lookup(bsym.kwargs)
+        in_tensors = _flat_tensors((margs, mkwargs))
+        needs_grad = any(t.name in diff for t in in_tensors)
+        out_is_diff = any(_is_diff_dtype(o) for o in bsym.flat_proxy_outs())
+
+        if needs_grad and out_is_diff and has_grad_rule(bsym.sym.id):
+            rule = augmented_forward_impls[bsym.sym.id]
+            res = rule(*margs, **mkwargs)
+            map_out(bsym.output, res.out)
+            new_outs = _flat_tensors(res.out)
+            tape.append(TapeEntry(bsym.sym.id, in_tensors, new_outs, tuple(res.residuals), None))
+            for o in new_outs:
+                if _is_diff_dtype(o):
+                    diff.add(o.name)
+            return
+        if needs_grad and out_is_diff and bsym.sym.id in JAX_VJP_FALLBACK:
+            _process_fallback(bsym, margs, mkwargs, in_tensors)
+            return
+        if needs_grad and out_is_diff and bsym.subsymbols:
+            for sub in bsym.subsymbols:
+                process(sub)
+            # map composite outputs: subsymbol processing populated env for
+            # the proxies the composite returns
+            map_out(bsym.output, lookup(bsym.output))
+            return
+        if needs_grad and out_is_diff:
+            raise NotImplementedError(
+                f"no grad rule for {bsym.sym.name} (id={bsym.sym.id}) and no decomposition"
+            )
+        # non-differentiable: re-emit
+        out = bsym.sym(*margs, **mkwargs)
+        map_out(bsym.output, out)
+
+    def _process_fallback(bsym, margs, mkwargs, in_tensors):
+        from ..executors import jaxex
+
+        impl = jaxex.ex.get_impl(bsym.sym.id)
+        fwd_sym, bwd_sym = _make_fallback_symbols(bsym.sym, impl)
+        outs_and_res = fwd_sym(*margs, **mkwargs)
+        new_out, res_proxy = outs_and_res
+        map_out(bsym.output, new_out)
+        new_outs = _flat_tensors(new_out)
+        tape.append(TapeEntry(("fallback", bsym.sym.id), in_tensors, new_outs, (res_proxy,), bwd_sym))
+        for o in new_outs:
+            if _is_diff_dtype(o):
+                diff.add(o.name)
+
+    with tracectx(fwd):
+        for bsym in trace.bound_symbols:
+            process(bsym)
+
+        # saved-for-backward = union of residual proxies (dedup, trace order)
+        saved: list[Proxy] = []
+        seen: set = set()
+        for entry in tape:
+            for r in entry.residuals:
+                if isinstance(r, Proxy) and r.name not in seen:
+                    seen.add(r.name)
+                    saved.append(r)
+        prims.python_return((fwd_output, tuple(saved)))
+
+    fwd_out_tensors = _flat_tensors(fwd_output)
+
+    # ---- build backward trace ----
+    bwd = TraceCtx(None)
+    bwd._name = "backward"
+    saved_mirror: dict[str, Proxy] = {}
+    bwd_args: list[Proxy] = []
+    with tracectx(bwd):
+        for p in saved:
+            if isinstance(p, TensorProxy):
+                m = TensorProxy(None, shape=p.shape, dtype=p.dtype, device=p.device)
+            elif isinstance(p, NumberProxy):
+                m = NumberProxy(p.value, p.python_type)
+            else:  # AnyProxy (opaque residuals, e.g. vjp closures)
+                from ..core.proxies import AnyProxy
+
+                m = AnyProxy(None)
+            saved_mirror[p.name] = m
+            bwd_args.append(m)
+        cot_map: dict[str, Proxy] = {}
+        for o in fwd_out_tensors:
+            if _is_diff_dtype(o):
+                c = TensorProxy(None, shape=o.shape, dtype=o.dtype, device=o.device)
+                cot_map[o.name] = c
+                bwd_args.append(c)
+        bwd.args = tuple(bwd_args)
+
+        grad_map: dict[str, Proxy] = dict(cot_map)
+
+        def res_lookup(r):
+            if isinstance(r, Proxy) and r.name in saved_mirror:
+                return saved_mirror[r.name]
+            if isinstance(r, (tuple, list)):
+                return type(r)(res_lookup(e) for e in r)
+            return r
+
+        def accumulate(p: TensorProxy, g):
+            if g is None:
+                return
+            if tuple(g.shape) != tuple(p.shape):
+                g = _sum_to_shape(g, p.shape)
+            if g.dtype != p.dtype and p.dtype.is_inexact:
+                g = prims.convert_element_type(g, p.dtype)
+            prev = grad_map.get(p.name)
+            grad_map[p.name] = g if prev is None else prims.add(prev, g)
+
+        for entry in reversed(tape):
+            cots = []
+            any_cot = False
+            for o in entry.outputs:
+                c = grad_map.get(o.name)
+                if c is not None:
+                    any_cot = True
+                else:
+                    c = clang.full(o.shape, 0.0, dtype=o.dtype, device=o.device) if _is_diff_dtype(o) else None
+                cots.append(c)
+            if not any_cot:
+                continue
+            # fill missing cotangents with zeros for multi-output rules
+            cots = [c for c, o in zip(cots, entry.outputs) if _is_diff_dtype(o) or c is not None]
+            if entry.fallback_impl is not None:
+                res = res_lookup(entry.residuals[0])
+                meta_spec = tuple((p.shape, p.dtype, p.device) for p in entry.inputs)
+                grads = entry.fallback_impl(res, meta_spec, *cots)
+            else:
+                rule = backward_impls[entry.sym_id]
+                res = tuple(res_lookup(r) for r in entry.residuals)
+                grads = rule(*res, *cots)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            for p, g in zip(entry.inputs, grads):
+                if isinstance(p, TensorProxy) and g is not None and _is_diff_dtype(p):
+                    accumulate(p, g)
+
+        grads_out = []
+        for p in grad_args:
+            g = grad_map.get(p.name)
+            if g is None:
+                g = clang.full(p.shape, 0.0, dtype=p.dtype, device=p.device)
+            grads_out.append(g)
+        prims.python_return(tuple(grads_out))
+
+    fwd = dce(fwd)
+    bwd = dce(bwd)
+    fwd.set_provenance("Augmented forward (autodiff)")
+    bwd.set_provenance("Backward (autodiff)")
+    return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names)
+
+
+_fallback_sym_cache: dict = {}
+
+
+def _make_fallback_symbols(sym: Symbol, impl: Callable):
+    """Create fwd/bwd symbols whose impls use jax.vjp of the op's jax impl at
+    runtime. The residual (the vjp closure) is carried as an opaque AnyProxy
+    between the forward and backward callables; both symbols are DONT_FUSE so
+    the closure never has to cross an XLA boundary."""
+    import jax
+
+    from ..core.proxies import AnyProxy
+
+    key = sym.id
+    if key in _fallback_sym_cache:
+        return _fallback_sym_cache[key]
+
+    def fwd_meta(*args, **kwargs):
+        out = sym.meta(*args, **kwargs)
+        res = AnyProxy(None)
+        return out, res
+
+    def fwd_impl(*args, **kwargs):
+        tensor_idx = [i for i, a in enumerate(args) if hasattr(a, "shape") and hasattr(a, "dtype")]
+
+        def call(*tensors):
+            full = list(args)
+            for i, t in zip(tensor_idx, tensors):
+                full[i] = t
+            return impl(*full, **kwargs)
+
+        out, vjp_fn = jax.vjp(call, *[args[i] for i in tensor_idx])
+        return out, vjp_fn
+
+    fwd_sym = Symbol(f"{sym.name}_vjp_fwd", fwd_meta, id=f"vjp_fwd.{sym.name}", is_prim=True,
+                     module="autodiff", tags=(OpTags.DONT_FUSE,), python_impl=fwd_impl)
+
+    def bwd_meta(res, meta_spec, *cots):
+        return tuple(TensorProxy(shape=s, dtype=d, device=dev) for (s, d, dev) in meta_spec)
+
+    def bwd_impl(res, meta_spec, *cots):
+        vjp_fn = res
+        grads = vjp_fn(cots[0] if len(cots) == 1 else tuple(cots))
+        return tuple(grads)
+
+    bwd_sym = Symbol(f"{sym.name}_vjp_bwd", bwd_meta, id=f"vjp_bwd.{sym.name}", is_prim=True,
+                     module="autodiff", tags=(OpTags.DONT_FUSE,), python_impl=bwd_impl)
+
+    _fallback_sym_cache[key] = (fwd_sym, bwd_sym)
+    return _fallback_sym_cache[key]
+
+
+# ---------------------------------------------------------------------------
+# runtime wrappers: value_and_grad / grad
+# ---------------------------------------------------------------------------
+
+
+class _VAGEntry(NamedTuple):
+    fwd_fn: Callable
+    bwd_fn: Callable
+    fwd_trc: TraceCtx
+    bwd_trc: TraceCtx
+    grad_leaf_positions: tuple  # positions (within tensor leaves) receiving grads
+    treedef: Any
+    tensor_mask: tuple
+
+
+class ThunderValueAndGrad:
+    """Callable returning (value, grads). grads is a pytree matching (args,
+    kwargs) with arrays at differentiated tensor leaves and None elsewhere.
+
+    Reference analog: thunder/core/transforms.py:3068 value_and_grad, combined
+    with the ThunderFunction autograd bridge (torch_autograd.py:17) — TPU-
+    native there is no runtime autograd tape, so the API is functional."""
+
+    def __init__(self, fn: Callable, argnums=None):
+        self.fn = fn
+        self.argnums = (argnums,) if isinstance(argnums, int) else (tuple(argnums) if argnums is not None else None)
+        self._cache: dict = {}
+        self._cs = None  # CompileStats of last compile
+
+    def _grad_mask(self, args, kwargs):
+        """Per-leaf requires-grad mask: argnums positions (or Parameter flags)."""
+        from ..core.pytree import tree_flatten
+
+        masks = []
+        if self.argnums is None:
+            leaves, _ = tree_flatten((args, kwargs))
+            return [bool(getattr(l, "requires_grad", False)) for l in leaves]
+        for i, a in enumerate(args):
+            leaves, _ = tree_flatten(a)
+            masks.extend([i in self.argnums] * len(leaves))
+        leaves, _ = tree_flatten(kwargs)
+        masks.extend([False] * len(leaves))
+        return masks
+
+    def _compile(self, args, kwargs, key):
+        import time as _time
+
+        from .. import ThunderCompiledFunction, _is_tensor_like, acquire_trace, resolve_executors
+        from ..common import CompileStats
+        from ..core.transform_common import dce as _dce
+        from ..executors.passes import transform_for_execution
+
+        cs = CompileStats()
+        self._cs = cs
+        grad_mask = self._grad_mask(args, kwargs)
+
+        t0 = _time.perf_counter_ns()
+        trc, treedef, tensor_mask, leaves = acquire_trace(self.fn, args, kwargs, grad_mask=grad_mask)
+        cs.last_trace_tracing_time_ns = _time.perf_counter_ns() - t0
+
+        t1 = _time.perf_counter_ns()
+        trc = _dce(trc)
+        fb = forward_and_backward_traces(trc)
+        fwd_claimed = transform_for_execution(fb.forward_trace, resolve_executors(None))
+        bwd_claimed = transform_for_execution(fb.backward_trace, resolve_executors(None))
+        cs.last_trace_transform_time_ns = _time.perf_counter_ns() - t1
+
+        t2 = _time.perf_counter_ns()
+        fwd_fn = fwd_claimed.python_callable()
+        bwd_fn = bwd_claimed.python_callable()
+        cs.last_compile_time_ns = _time.perf_counter_ns() - t2
+        cs.last_traces = [trc, fb.forward_trace, fwd_claimed]
+        cs.last_backward_traces = [fb.backward_trace, bwd_claimed]
+
+        arg_name_to_pos = {p.name: i for i, p in enumerate(trc.args)}
+        grad_positions = tuple(arg_name_to_pos[n] for n in fb.grad_arg_names)
+        entry = _VAGEntry(fwd_fn, bwd_fn, fwd_claimed, bwd_claimed, grad_positions, treedef, tuple(tensor_mask))
+        self._cache[key] = entry
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        import jax.numpy as jnp
+
+        from .. import _cache_key, _is_tensor_like, _unwrap
+        from ..core.pytree import tree_flatten, tree_unflatten
+
+        leaves, treedef = tree_flatten((args, kwargs))
+        tensor_mask = [_is_tensor_like(l) for l in leaves]
+        key = _cache_key(leaves, tensor_mask)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(args, kwargs, key)
+        tensor_leaves = [_unwrap(l) for l, m in zip(leaves, tensor_mask) if m]
+        out, saved = entry.fwd_fn(*tensor_leaves)
+        # cotangent: scalar loss -> 1.0
+        cot = jnp.ones((), dtype=jnp.asarray(out).dtype) if hasattr(out, "dtype") else 1.0
+        grads_flat = entry.bwd_fn(*saved, cot)
+        # scatter grads back into the input pytree
+        grads_by_tensor_pos = {p: g for p, g in zip(entry.grad_leaf_positions, grads_flat)}
+        grad_leaves = []
+        ti = 0
+        for m in tensor_mask:
+            if m:
+                grad_leaves.append(grads_by_tensor_pos.get(ti))
+                ti += 1
+            else:
+                grad_leaves.append(None)
+        grads = tree_unflatten(treedef, grad_leaves)
+        return out, grads
+
+
+def value_and_grad(fn, argnums=None):
+    """(value, grads) over a callable, Module, or compiled function."""
+    from .. import ThunderCompiledFunction
+    from ..nn.module import Module, ThunderModule
+
+    if isinstance(fn, ThunderModule):
+        return ModuleValueAndGrad(fn)
+    if isinstance(fn, Module):
+        from .. import jit
+
+        return ModuleValueAndGrad(jit(fn))
+    if isinstance(fn, ThunderCompiledFunction):
+        fn = fn._cd.fn
+    return ThunderValueAndGrad(fn, argnums)
+
+
+def grad(fn, argnums=None):
+    vag = value_and_grad(fn, argnums)
+
+    def grad_fn(*args, **kwargs):
+        _, g = vag(*args, **kwargs)
+        return g
+
+    grad_fn.__wrapped_vag__ = vag
+    return grad_fn
+
+
+class ModuleValueAndGrad:
+    """value_and_grad over a ThunderModule: returns (loss, {param_name: grad}).
+
+    The traced wrapper takes (params_dict, args, kwargs); parameters are
+    requires_grad leaves, so grads land exactly on them."""
+
+    def __init__(self, tmodule):
+        self.tmodule = tmodule
+        self._vag = ThunderValueAndGrad(tmodule._cfn._cd.fn, argnums=None)
+
+    @property
+    def _cs(self):
+        return self._vag._cs
+
+    def __call__(self, *args, **kwargs):
+        params = self.tmodule.get_parameters()
+        loss, grads = self._vag(params, args, kwargs)
+        # grads mirrors ((params, args, kwargs), {}) -> params grads dict
+        param_grads = grads[0][0]
+        return loss, param_grads
