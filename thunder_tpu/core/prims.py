@@ -43,6 +43,7 @@ class PrimIDs(Enum):
     STOP_GRADIENT = auto()
     BITCAST = auto()
     # factories
+    TENSOR_CONSTANT = auto()
     FULL = auto()
     IOTA = auto()
     UNIFORM = auto()
@@ -300,6 +301,15 @@ bitcast = make_prim(PrimIDs.BITCAST, "bitcast", _bitcast_meta)
 # ---------------------------------------------------------------------------
 # factories
 # ---------------------------------------------------------------------------
+
+
+def _tensor_constant_meta(array):
+    from . import dtypes as _dt
+
+    return TensorProxy(shape=tuple(array.shape), dtype=_dt.to_dtype(array.dtype))
+
+
+tensor_constant = make_prim(PrimIDs.TENSOR_CONSTANT, "tensor_constant", _tensor_constant_meta)
 
 
 def _full_meta(shape, fill_value, *, device=None, dtype=None):

@@ -55,6 +55,9 @@ _reg(PrimIDs.BITCAST, lambda a, dtype: lax.bitcast_convert_type(a, _jd(dtype)))
 
 
 # ---- factories ----
+_reg(PrimIDs.TENSOR_CONSTANT, jnp.asarray)
+
+
 def _full(shape, fill_value, *, device=None, dtype=None):
     return jnp.full(shape, fill_value, dtype=_jd(dtype))
 

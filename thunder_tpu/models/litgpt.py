@@ -1,0 +1,266 @@
+"""LitGPT-style configurable transformer in thunder_tpu's op language.
+
+Capability counterpart of the reference's in-repo model zoo
+(thunder/tests/litgpt_model.py — LitGPT config + GPT reimplementation used by
+its benchmarks and network tests). Covers the same architectural axes: RoPE,
+RMSNorm/LayerNorm, GQA (n_query_groups), GptNeox vs LLaMA (SwiGLU) MLPs,
+parallel residuals, tied/untied heads. Configs include Llama-2/Llama-3 class
+models plus tiny test configs.
+
+TPU notes: weights default to bfloat16-friendly fp32 masters; attention runs
+through ltorch.sdpa which the Pallas flash-attention executor claims whole."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import ltorch
+
+
+@dataclass
+class Config:
+    name: str = "tiny"
+    block_size: int = 128
+    vocab_size: int = 512
+    padded_vocab_size: Optional[int] = None
+    n_layer: int = 2
+    n_head: int = 4
+    n_embd: int = 64
+    head_size: Optional[int] = None
+    n_query_groups: Optional[int] = None
+    rotary_percentage: float = 1.0
+    parallel_residual: bool = False
+    bias: bool = False
+    norm_class_name: str = "RMSNorm"
+    mlp_class_name: str = "LLaMAMLP"
+    intermediate_size: Optional[int] = None
+    norm_eps: float = 1e-5
+    rope_base: int = 10000
+    lm_head_bias: bool = False
+    shared_embedding: bool = False
+
+    def __post_init__(self):
+        if self.padded_vocab_size is None:
+            self.padded_vocab_size = _next_multiple(self.vocab_size, 128)
+        if self.head_size is None:
+            self.head_size = self.n_embd // self.n_head
+        if self.n_query_groups is None:
+            self.n_query_groups = self.n_head
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.n_embd
+
+    @property
+    def rope_n_elem(self) -> int:
+        return int(self.rotary_percentage * self.head_size)
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "Config":
+        cfg = dict(configs[name])
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+def _next_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+configs: dict[str, dict] = {
+    "tiny": dict(name="tiny", block_size=128, vocab_size=512, n_layer=2, n_head=4, n_embd=64),
+    "tiny-llama2": dict(
+        name="tiny-llama2", block_size=256, vocab_size=320, n_layer=3, n_head=4, n_query_groups=2,
+        n_embd=128, intermediate_size=352, norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP",
+    ),
+    "tiny-gptneox": dict(
+        name="tiny-gptneox", block_size=128, vocab_size=320, n_layer=2, n_head=4, n_embd=64,
+        norm_class_name="LayerNorm", mlp_class_name="GptNeoxMLP", parallel_residual=True, bias=True,
+    ),
+    # benchmark-class configs (matching LitGPT hyperparameters)
+    "nanogpt-124m": dict(
+        name="nanogpt-124m", block_size=1024, vocab_size=50257, n_layer=12, n_head=12, n_embd=768,
+        norm_class_name="LayerNorm", mlp_class_name="GptNeoxMLP", bias=True,
+    ),
+    "Llama-2-7b-hf": dict(
+        name="Llama-2-7b-hf", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
+        n_layer=32, n_head=32, n_embd=4096, intermediate_size=11008,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=10000,
+    ),
+    "Llama-2-13b-hf": dict(
+        name="Llama-2-13b-hf", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
+        n_layer=40, n_head=40, n_embd=5120, intermediate_size=13824,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=10000,
+    ),
+    "Llama-3-8B": dict(
+        name="Llama-3-8B", block_size=8192, vocab_size=128000, padded_vocab_size=128256,
+        n_layer=32, n_head=32, n_query_groups=8, n_embd=4096, intermediate_size=14336,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=500000,
+    ),
+    "Llama-3-1B": dict(
+        name="Llama-3-1B", block_size=8192, vocab_size=128000, padded_vocab_size=128256,
+        n_layer=16, n_head=32, n_query_groups=8, n_embd=2048, intermediate_size=8192,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=500000,
+    ),
+}
+
+
+def _norm(cfg: Config, dtype):
+    if cfg.norm_class_name == "RMSNorm":
+        return nn.RMSNorm(cfg.n_embd, eps=cfg.norm_eps, dtype=dtype)
+    return nn.LayerNorm(cfg.n_embd, eps=cfg.norm_eps, dtype=dtype)
+
+
+class GptNeoxMLP(nn.Module):
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.fc = nn.Linear(cfg.n_embd, cfg.intermediate_size, bias=cfg.bias, dtype=dtype)
+        self.proj = nn.Linear(cfg.intermediate_size, cfg.n_embd, bias=cfg.bias, dtype=dtype)
+
+    def forward(self, x):
+        return self.proj(ltorch.gelu(self.fc(x), approximate="tanh"))
+
+
+class LLaMAMLP(nn.Module):
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.fc_1 = nn.Linear(cfg.n_embd, cfg.intermediate_size, bias=cfg.bias, dtype=dtype)
+        self.fc_2 = nn.Linear(cfg.n_embd, cfg.intermediate_size, bias=cfg.bias, dtype=dtype)
+        self.proj = nn.Linear(cfg.intermediate_size, cfg.n_embd, bias=cfg.bias, dtype=dtype)
+
+    def forward(self, x):
+        return self.proj(ltorch.silu(self.fc_1(x)) * self.fc_2(x))
+
+
+class CausalSelfAttention(nn.Module):
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        shape = (cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size
+        self.attn = nn.Linear(cfg.n_embd, shape, bias=cfg.bias, dtype=dtype)
+        self.proj = nn.Linear(cfg.n_head * cfg.head_size, cfg.n_embd, bias=cfg.bias, dtype=dtype)
+
+    def forward(self, x, cos, sin):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+        qkv = self.attn(x)
+        # split grouped qkv: (B, T, (nh + 2*ng) * hs)
+        q_per_kv = nh // ng
+        qkv = ltorch.reshape(qkv, (B, T, ng, q_per_kv + 2, hs))
+        q = qkv[:, :, :, : q_per_kv, :]
+        k = qkv[:, :, :, q_per_kv: q_per_kv + 1, :]
+        v = qkv[:, :, :, q_per_kv + 1:, :]
+        q = ltorch.reshape(q, (B, T, nh, hs))
+        k = ltorch.reshape(k, (B, T, ng, hs))
+        v = ltorch.reshape(v, (B, T, ng, hs))
+        q = ltorch.permute(q, (0, 2, 1, 3))  # (B, nh, T, hs)
+        k = ltorch.permute(k, (0, 2, 1, 3))
+        v = ltorch.permute(v, (0, 2, 1, 3))
+
+        n_elem = cfg.rope_n_elem
+        q = _apply_rope(q, cos, sin, n_elem)
+        k = _apply_rope(k, cos, sin, n_elem)
+
+        if ng != nh:
+            k = _repeat_kv(k, q_per_kv)
+            v = _repeat_kv(v, q_per_kv)
+
+        y = ltorch.sdpa(q, k, v, is_causal=True, scale=1.0 / math.sqrt(hs))
+        y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * hs))
+        return self.proj(y)
+
+
+def _repeat_kv(x, n: int):
+    # (B, ng, T, hs) -> (B, ng*n, T, hs)
+    B, ng, T, hs = x.shape
+    x = ltorch.unsqueeze(x, 2)
+    x = ltorch.expand(x, (B, ng, n, T, hs))
+    return ltorch.reshape(x, (B, ng * n, T, hs))
+
+
+def _apply_rope(x, cos, sin, n_elem: int):
+    if n_elem <= 0:
+        return x
+    hs = x.shape[-1]
+    rot = x[..., :n_elem]
+    x1 = rot[..., : n_elem // 2]
+    x2 = rot[..., n_elem // 2:]
+    rotated = ltorch.cat([-x2, x1], -1)
+    roped = rot * cos + rotated * sin
+    if n_elem < hs:
+        return ltorch.cat([roped, x[..., n_elem:]], -1)
+    return roped
+
+
+class Block(nn.Module):
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        self.norm_1 = _norm(cfg, dtype)
+        self.attn = CausalSelfAttention(cfg, dtype)
+        self.norm_2 = _norm(cfg, dtype)
+        self.mlp = {"LLaMAMLP": LLaMAMLP, "GptNeoxMLP": GptNeoxMLP}[cfg.mlp_class_name](cfg, dtype)
+
+    def forward(self, x, cos, sin):
+        h = self.attn(self.norm_1(x), cos, sin)
+        if self.cfg.parallel_residual:
+            return x + h + self.mlp(self.norm_2(x))
+        x = x + h
+        return x + self.mlp(self.norm_2(x))
+
+
+class GPT(nn.Module):
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = nn.Embedding(cfg.padded_vocab_size, cfg.n_embd, dtype=dtype)
+        self.h = nn.ModuleList([Block(cfg, dtype) for _ in range(cfg.n_layer)])
+        self.ln_f = _norm(cfg, dtype)
+        self.lm_head = nn.Linear(cfg.n_embd, cfg.padded_vocab_size, bias=cfg.lm_head_bias, dtype=dtype)
+        cos, sin = build_rope_cache(cfg.block_size, cfg.rope_n_elem, cfg.rope_base, dtype)
+        self.register_buffer("cos", cos)
+        self.register_buffer("sin", sin)
+
+    def forward(self, idx):
+        B, T = idx.shape
+        cos = self.cos[:T]
+        sin = self.sin[:T]
+        x = self.wte(idx)
+        for block in self.h:
+            x = block(x, cos, sin)
+        x = self.ln_f(x)
+        return self.lm_head(x)
+
+
+class GPTForCausalLM(nn.Module):
+    """GPT + shifted cross-entropy loss — the pretraining step target."""
+
+    def __init__(self, cfg: Config, dtype=jnp.float32):
+        super().__init__()
+        self.gpt = GPT(cfg, dtype)
+        self.cfg = cfg
+
+    def forward(self, idx, targets):
+        logits = self.gpt(idx)
+        B, T, V = logits.shape
+        return ltorch.cross_entropy(
+            ltorch.reshape(logits, (B * T, V)), ltorch.reshape(targets, (B * T,))
+        )
+
+
+def build_rope_cache(seq_len: int, n_elem: int, base: int = 10000, dtype=jnp.float32):
+    if n_elem <= 0:
+        z = jnp.zeros((seq_len, 0), dtype)
+        return z, z
+    theta = 1.0 / (base ** (jnp.arange(0, n_elem, 2, dtype=jnp.float32) / n_elem))
+    seq = jnp.arange(seq_len, dtype=jnp.float32)
+    idx_theta = jnp.outer(seq, theta)  # (T, n_elem/2)
+    idx_theta = jnp.concatenate([idx_theta, idx_theta], axis=-1)  # (T, n_elem)
+    return jnp.cos(idx_theta).astype(dtype), jnp.sin(idx_theta).astype(dtype)
+
+
+def name_to_config(name: str) -> dict:
+    return configs[name]

@@ -18,6 +18,25 @@ def is_tensor(x) -> bool:
     return isinstance(x, TensorProxy)
 
 
+def constant(array) -> TensorProxy:
+    """Wrap a concrete array (model buffer, rope cache, ...) as a trace-level
+    constant tensor. The array is carried out-of-line and becomes an XLA
+    constant inside fused regions."""
+    return prims.tensor_constant(array)
+
+
+def _is_concrete_array(x) -> bool:
+    return (not isinstance(x, TensorProxy)) and hasattr(x, "shape") and hasattr(x, "dtype") \
+        and not isinstance(x, (Number, NumberProxy))
+
+
+def ensure_proxy(x):
+    """Arrays become constant proxies; proxies and numbers pass through."""
+    if _is_concrete_array(x):
+        return constant(x)
+    return x
+
+
 # ---------------------------------------------------------------------------
 # dtype conversion & promotion
 # ---------------------------------------------------------------------------
@@ -96,6 +115,7 @@ def expand_to(a: TensorProxy, shape: tuple) -> TensorProxy:
 
 
 def _elementwise_binary(prim, a, b, *, int_to_float=False, bool_out=False):
+    a, b = ensure_proxy(a), ensure_proxy(b)
     dt = _result_dtype(a, b, int_to_float=int_to_float)
     a, b = maybe_broadcast(a, b)
     if not bool_out:
@@ -218,6 +238,7 @@ def to_bool(a):
 
 
 def where(pred, a, b):
+    pred, a, b = ensure_proxy(pred), ensure_proxy(a), ensure_proxy(b)
     dt = _result_dtype(a, b)
     pred, a, b = maybe_broadcast(pred, a, b)
     if isinstance(a, TensorProxy):
@@ -350,7 +371,7 @@ def chunk(a: TensorProxy, chunks: int, dim=0):
 
 
 def cat(tensors, dim=0):
-    tensors = [t for t in tensors]
+    tensors = [ensure_proxy(t) for t in tensors]
     dim = canonicalize_dim(tensors[0].ndim, pyval(dim))
     dt = _result_dtype(*tensors)
     tensors = [maybe_convert_to_dtype(t, dt) for t in tensors]

@@ -1,0 +1,100 @@
+"""Optimizers: functional cores + stateful torch-style wrappers.
+
+The reference delegates optimizers to torch.optim (used by its LitGPT
+benchmark harness, thunder/benchmarks/benchmark_litgpt.py). TPU-native, the
+optimizer must live inside the single XLA training-step program, so the cores
+here are pure-jax functions over (params, grads, state) pytrees that the
+train-step compiler fuses with forward+backward."""
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGD:
+    def __init__(self, lr: float = 0.01, momentum: float = 0.0, weight_decay: float = 0.0):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+
+    def init(self, params: dict) -> dict:
+        if self.momentum == 0.0:
+            return {"step": jnp.zeros((), jnp.int32)}
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "momentum_buf": {k: jnp.zeros_like(v) for k, v in params.items()},
+        }
+
+    def update(self, params: dict, grads: dict, state: dict):
+        new_params = {}
+        new_state = {"step": state["step"] + 1}
+        if self.momentum != 0.0:
+            new_buf = {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                if self.momentum != 0.0:
+                    new_buf[k] = state["momentum_buf"][k]
+                continue
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            if self.momentum != 0.0:
+                buf = self.momentum * state["momentum_buf"][k] + g
+                new_buf[k] = buf
+                g = buf
+            new_params[k] = p - self.lr * g
+        if self.momentum != 0.0:
+            new_state["momentum_buf"] = new_buf
+        return new_params, new_state
+
+
+class AdamW:
+    """Decoupled weight decay Adam; state in f32 regardless of param dtype."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.01):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params: dict) -> dict:
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "m": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
+            "v": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
+        }
+
+    def update(self, params: dict, grads: dict, state: dict):
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - self.beta1**t
+        bc2 = 1.0 - self.beta2**t
+        new_params, new_m, new_v = {}, {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k], new_m[k], new_v[k] = p, state["m"][k], state["v"][k]
+                continue
+            g32 = g.astype(jnp.float32)
+            m = self.beta1 * state["m"][k] + (1.0 - self.beta1) * g32
+            v = self.beta2 * state["v"][k] + (1.0 - self.beta2) * (g32 * g32)
+            mhat = m / bc1
+            vhat = v / bc2
+            upd = mhat / (jnp.sqrt(vhat) + self.eps)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                p32 = p32 - self.lr * self.weight_decay * p32
+            p32 = p32 - self.lr * upd
+            new_params[k] = p32.astype(p.dtype)
+            new_m[k], new_v[k] = m, v
+        return new_params, {"step": step, "m": new_m, "v": new_v}
+
+
+class Adam(AdamW):
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8):
+        super().__init__(lr, betas, eps, weight_decay=0.0)

@@ -694,6 +694,11 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
             # the proxies the composite returns
             map_out(bsym.output, lookup(bsym.output))
             return
+        if needs_grad and out_is_diff and not bsym.sym.is_prim:
+            # composite that recorded nothing: a pure pass-through (e.g. a
+            # full-range getitem); outputs are existing proxies
+            map_out(bsym.output, lookup(bsym.output))
+            return
         if needs_grad and out_is_diff:
             raise NotImplementedError(
                 f"no grad rule for {bsym.sym.name} (id={bsym.sym.id}) and no decomposition"
