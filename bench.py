@@ -1,0 +1,106 @@
+"""Benchmark: GPT pretraining step tokens/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares the fused thunder_tpu step against op-by-op (unfused)
+execution of the same traces — the analog of the reference's headline
+"vs PyTorch eager" speedup (reference README.md:23)."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
+    import thunder_tpu as tt
+    from thunder_tpu import optim
+    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.training import TrainStep
+
+    cfg = Config.from_name(model_name, block_size=T)
+    model = GPTForCausalLM(cfg)
+    step = TrainStep(model, optim.AdamW(lr=1e-4))
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+
+    for _ in range(warmup):
+        step(idx, tgt).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(idx, tgt)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    return (B * T * iters) / dt, float(loss)
+
+
+def _bench_opbyop(model_name: str, B: int, T: int, iters: int):
+    """Unfused op-by-op execution of the same forward+backward (the 'eager'
+    baseline): every prim dispatches separately through jaxex."""
+    import thunder_tpu as tt
+    from thunder_tpu.executors import jaxex
+    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.transforms.autodiff import ThunderValueAndGrad
+    from thunder_tpu.executors.passes import transform_for_execution
+
+    cfg = Config.from_name(model_name, block_size=T)
+    model = GPTForCausalLM(cfg)
+    tm = tt.jit(model)
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+
+    vag = ThunderValueAndGrad(tm._cfn._cd.fn, argnums=0)
+    # compile with fusion disabled: claims stay per-prim on jaxex
+    import thunder_tpu
+
+    orig = thunder_tpu.resolve_executors
+
+    def no_fusion(execs=None):
+        return (jaxex.ex,)
+
+    thunder_tpu.resolve_executors = no_fusion
+    try:
+        params = {k: p for k, p in tm.get_parameters().items()}
+        loss, grads = vag(params, (idx, tgt), {})  # compiles unfused
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, grads = vag(params, (idx, tgt), {})
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        thunder_tpu.resolve_executors = orig
+    return (B * T * iters) / dt
+
+
+def main():
+    model_name = os.environ.get("BENCH_MODEL", "nanogpt-124m")
+    B = int(os.environ.get("BENCH_BATCH", "8"))
+    T = int(os.environ.get("BENCH_SEQLEN", "1024"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    fused_tps, loss = _bench_fused(model_name, B, T, iters=iters, warmup=3)
+
+    try:
+        eager_tps = _bench_opbyop(model_name, B, T, iters=2)
+        vs_baseline = fused_tps / eager_tps
+    except Exception as e:
+        print(f"# op-by-op baseline failed: {e}", file=sys.stderr)
+        vs_baseline = 1.0
+
+    print(json.dumps({
+        "metric": f"{model_name} pretrain tokens/sec/chip (B={B}, T={T}, fwd+bwd+adamw)",
+        "value": round(fused_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

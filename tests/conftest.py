@@ -1,0 +1,24 @@
+"""Test configuration: virtual 8-device CPU mesh, float64 oracle enabled.
+
+Mirrors the reference's distributed test strategy (SURVEY.md §4): the
+reference spawns real NCCL processes (thunder/tests/distributed/helper.py:146);
+on the jax stack a virtual CPU mesh via --xla_force_host_platform_device_count
+covers multi-device semantics in-process."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may say "axon" (TPU tunnel)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

@@ -1,0 +1,127 @@
+"""OpInfo-style test framework: op × executor × dtype matrix vs a jax oracle.
+
+Re-design of reference thunder/tests/opinfos.py:289 (OpInfo) and
+thunder/tests/framework.py:381 (@ops): each OpInfo carries sample generators
+and a jax reference implementation; tests instantiate per (op, executor-mode,
+dtype)."""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core import dtypes
+
+
+@dataclass
+class SampleInput:
+    args: tuple
+    kwargs: dict = field(default_factory=dict)
+
+
+_F32 = (dtypes.float32,)
+_F64 = (dtypes.float64,)
+
+
+@dataclass
+class OpInfo:
+    name: str
+    op: Callable  # thunder_tpu op (called on proxies)
+    ref: Callable  # jax reference (called on arrays)
+    sample_generator: Callable  # (rng, dtype) -> iterable[SampleInput]
+    dtypes: tuple = _F32
+    atol: float = 1e-5
+    rtol: float = 1e-5
+    supports_grad: bool = True
+    grad_dtypes: tuple = _F64
+
+
+class ExecutorMode:
+    """Test executor axis (reference TestExecutor subclasses, framework.py:152)."""
+
+    def __init__(self, name: str, disable_fusion: bool):
+        self.name = name
+        self.disable_fusion = disable_fusion
+
+
+EXECUTOR_MODES = (
+    ExecutorMode("fused", disable_fusion=False),
+    ExecutorMode("opbyop", disable_fusion=True),
+)
+
+
+def make_tensor(rng: np.random.RandomState, shape, dtype: dtypes.dtype, *, low=-2.0, high=2.0):
+    jd = dtypes.to_jax_dtype(dtype)
+    if dtype.is_bool:
+        return jnp.asarray(rng.rand(*shape) > 0.5)
+    if dtype.is_int:
+        return jnp.asarray(rng.randint(int(low) if low > -10 else -10, int(high) if high > 2 else 10, shape), jd)
+    return jnp.asarray(rng.uniform(low, high, shape), jd)
+
+
+def ops(opinfos: Sequence[OpInfo], modes: Sequence[ExecutorMode] = EXECUTOR_MODES):
+    """Parametrize a test over (opinfo, mode, dtype)."""
+    params = []
+    for oi, mode, dt in itertools.product(opinfos, modes, None or [None]):
+        for dt in oi.dtypes:
+            params.append(pytest.param(oi, mode, dt, id=f"{oi.name}-{mode.name}-{dt.shortname}"))
+
+    def deco(fn):
+        return pytest.mark.parametrize("opinfo,mode,dtype", params)(fn)
+
+    return deco
+
+
+def assert_close(actual, expected, atol, rtol):
+    a = np.asarray(actual)
+    e = np.asarray(expected)
+    assert a.shape == tuple(e.shape), f"shape {a.shape} != {e.shape}"
+    np.testing.assert_allclose(a.astype(np.float64) if a.dtype != bool else a,
+                               e.astype(np.float64) if e.dtype != bool else e,
+                               atol=atol, rtol=rtol)
+
+
+def run_op_test(opinfo: OpInfo, mode: ExecutorMode, dtype, rng):
+    found = False
+    for sample in opinfo.sample_generator(rng, dtype):
+        found = True
+        cf = tt.jit(lambda *a, **kw: opinfo.op(*a, **kw), disable_fusion=mode.disable_fusion)
+        out = cf(*sample.args, **sample.kwargs)
+        ref_out = opinfo.ref(*sample.args, **sample.kwargs)
+        flat_out = out if isinstance(out, (tuple, list)) else (out,)
+        flat_ref = ref_out if isinstance(ref_out, (tuple, list)) else (ref_out,)
+        for o, r in zip(flat_out, flat_ref):
+            assert_close(o, r, opinfo.atol, opinfo.rtol)
+    assert found, "sample generator yielded nothing"
+
+
+def check_vjp(op, ref, sample: SampleInput, *, atol=1e-4, rtol=1e-4, argnums=None):
+    """Compare thunder_tpu grads of sum(op(...)) against jax.grad of the reference."""
+    import jax
+
+    tensor_argnums = tuple(
+        i for i, a in enumerate(sample.args)
+        if hasattr(a, "dtype") and jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+    )
+    if argnums is not None:
+        tensor_argnums = tuple(i for i in tensor_argnums if i in argnums)
+
+    def loss_tt(*args):
+        return tt.ops.ltorch.sum(op(*args, **sample.kwargs))
+
+    def loss_ref(*args):
+        return jnp.sum(ref(*args, **sample.kwargs))
+
+    vag = tt.value_and_grad(loss_tt, argnums=tensor_argnums)
+    val, grads = vag(*sample.args)
+    rval, rgrads = jax.value_and_grad(loss_ref, argnums=tensor_argnums)(*sample.args)
+    assert_close(val, rval, atol, rtol)
+    garg = grads[0]
+    for i, rg in zip(tensor_argnums, rgrads):
+        assert garg[i] is not None, f"missing grad for arg {i}"
+        assert_close(garg[i], rg, atol, rtol)

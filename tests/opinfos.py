@@ -1,0 +1,283 @@
+"""OpInfo database (reference thunder/tests/opinfos.py:289, 247 instances —
+grown here over rounds; the generator pattern matches)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.ops import ltorch
+
+from framework import OpInfo, SampleInput, make_tensor
+
+F32 = (dtypes.float32,)
+F32_64 = (dtypes.float32, dtypes.float64)
+FLOATS = (dtypes.float32, dtypes.float64, dtypes.bfloat16)
+INTS = (dtypes.int32, dtypes.int64)
+
+
+def elementwise_unary_samples(rng, dtype, *, low=-2.0, high=2.0):
+    for shape in ((), (7,), (3, 4), (2, 3, 5)):
+        yield SampleInput((make_tensor(rng, shape, dtype, low=low, high=high),))
+
+
+def positive_unary_samples(rng, dtype):
+    yield from elementwise_unary_samples(rng, dtype, low=0.1, high=4.0)
+
+
+def elementwise_binary_samples(rng, dtype):
+    for shape in ((7,), (3, 4)):
+        yield SampleInput((make_tensor(rng, shape, dtype), make_tensor(rng, shape, dtype)))
+    # broadcasting
+    yield SampleInput((make_tensor(rng, (3, 1, 5), dtype), make_tensor(rng, (4, 5), dtype)))
+    # scalar operand
+    yield SampleInput((make_tensor(rng, (3, 4), dtype), 1.5 if dtype.is_float else 2))
+
+
+def _u(name, ref, sample_gen=elementwise_unary_samples, dts=FLOATS, atol=1e-5, rtol=1e-5, bf16_tol=2e-2):
+    return OpInfo(name=name, op=getattr(ltorch, name), ref=ref, sample_generator=sample_gen,
+                  dtypes=dts, atol=atol, rtol=rtol)
+
+
+unary_opinfos = [
+    _u("abs", jnp.abs),
+    _u("neg", jnp.negative),
+    _u("exp", jnp.exp),
+    _u("expm1", jnp.expm1),
+    _u("log", jnp.log, positive_unary_samples),
+    _u("log1p", jnp.log1p, positive_unary_samples),
+    _u("sqrt", jnp.sqrt, positive_unary_samples),
+    _u("rsqrt", lambda x: 1.0 / jnp.sqrt(x), positive_unary_samples, atol=1e-4, rtol=1e-4),
+    _u("sin", jnp.sin),
+    _u("cos", jnp.cos),
+    _u("tanh", jnp.tanh),
+    _u("erf", jax.scipy.special.erf),
+    _u("floor", jnp.floor),
+    _u("ceil", jnp.ceil),
+    _u("sign", jnp.sign),
+    _u("sigmoid", jax.nn.sigmoid),
+    _u("relu", jax.nn.relu),
+    _u("silu", jax.nn.silu, atol=1e-4, rtol=1e-4),
+    OpInfo(name="gelu", op=ltorch.gelu, ref=functools.partial(jax.nn.gelu, approximate=False),
+           sample_generator=elementwise_unary_samples, dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="gelu_tanh", op=functools.partial(ltorch.gelu, approximate="tanh"),
+           ref=functools.partial(jax.nn.gelu, approximate=True),
+           sample_generator=elementwise_unary_samples, dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    _u("isfinite", jnp.isfinite),
+    _u("isnan", jnp.isnan),
+]
+
+binary_opinfos = [
+    OpInfo(name="add", op=ltorch.add, ref=jnp.add, sample_generator=elementwise_binary_samples, dtypes=FLOATS + INTS),
+    OpInfo(name="sub", op=ltorch.sub, ref=jnp.subtract, sample_generator=elementwise_binary_samples, dtypes=FLOATS + INTS),
+    OpInfo(name="mul", op=ltorch.mul, ref=jnp.multiply, sample_generator=elementwise_binary_samples, dtypes=FLOATS + INTS),
+    OpInfo(name="div", op=ltorch.div, ref=jnp.true_divide, sample_generator=elementwise_binary_samples, dtypes=F32_64),
+    OpInfo(name="maximum", op=ltorch.maximum, ref=jnp.maximum, sample_generator=elementwise_binary_samples, dtypes=F32_64 + INTS),
+    OpInfo(name="minimum", op=ltorch.minimum, ref=jnp.minimum, sample_generator=elementwise_binary_samples, dtypes=F32_64 + INTS),
+    OpInfo(name="pow", op=ltorch.pow, ref=jnp.power,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt, low=0.2, high=2.0),
+                                                               make_tensor(rng, (3, 4), dt, low=-1.0, high=2.0)))]),
+           dtypes=F32_64),
+    OpInfo(name="eq", op=ltorch.eq, ref=jnp.equal, sample_generator=elementwise_binary_samples, dtypes=F32_64 + INTS, supports_grad=False),
+    OpInfo(name="lt", op=ltorch.lt, ref=jnp.less, sample_generator=elementwise_binary_samples, dtypes=F32_64 + INTS, supports_grad=False),
+    OpInfo(name="ge", op=ltorch.ge, ref=jnp.greater_equal, sample_generator=elementwise_binary_samples, dtypes=F32_64 + INTS, supports_grad=False),
+]
+
+
+def reduction_samples(rng, dtype):
+    t = make_tensor(rng, (3, 4, 5), dtype)
+    yield SampleInput((t,))
+    yield SampleInput((t,), {"dim": 1})
+    yield SampleInput((t,), {"dim": (0, 2)})
+    yield SampleInput((t,), {"dim": -1, "keepdim": True})
+
+
+reduction_opinfos = [
+    OpInfo(name="sum", op=ltorch.sum, ref=lambda a, dim=None, keepdim=False: jnp.sum(a, axis=dim, keepdims=keepdim),
+           sample_generator=reduction_samples, dtypes=F32_64),
+    OpInfo(name="mean", op=ltorch.mean, ref=lambda a, dim=None, keepdim=False: jnp.mean(a, axis=dim, keepdims=keepdim),
+           sample_generator=reduction_samples, dtypes=F32_64),
+    OpInfo(name="amax", op=ltorch.amax, ref=lambda a, dim=None, keepdim=False: jnp.max(a, axis=dim, keepdims=keepdim),
+           sample_generator=reduction_samples, dtypes=F32_64),
+    OpInfo(name="amin", op=ltorch.amin, ref=lambda a, dim=None, keepdim=False: jnp.min(a, axis=dim, keepdims=keepdim),
+           sample_generator=reduction_samples, dtypes=F32_64),
+    OpInfo(name="argmax", op=ltorch.argmax, ref=lambda a, dim=None, keepdim=False: jnp.argmax(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),), {"dim": 1})]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="var", op=ltorch.var, ref=lambda a, dim=None, keepdim=False: jnp.var(a, axis=dim, keepdims=keepdim, ddof=1),
+           sample_generator=reduction_samples, dtypes=F32_64),
+    OpInfo(name="cumsum", op=ltorch.cumsum, ref=lambda a, dim: jnp.cumsum(a, axis=dim),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),), {"dim": 1})]),
+           dtypes=F32_64),
+]
+
+
+def shape_samples_reshape(rng, dtype):
+    yield SampleInput((make_tensor(rng, (2, 3, 4), dtype), (6, 4)))
+    yield SampleInput((make_tensor(rng, (2, 3, 4), dtype), (-1,)))
+    yield SampleInput((make_tensor(rng, (2, 3, 4), dtype), (2, -1)))
+
+
+shape_opinfos = [
+    OpInfo(name="reshape", op=ltorch.reshape, ref=lambda a, s: jnp.reshape(a, s),
+           sample_generator=shape_samples_reshape, dtypes=F32),
+    OpInfo(name="permute", op=ltorch.permute, ref=lambda a, d: jnp.transpose(a, d),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt), (2, 0, 1)))]),
+           dtypes=F32),
+    OpInfo(name="transpose", op=ltorch.transpose, ref=lambda a, d0, d1: jnp.swapaxes(a, d0, d1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt), 0, 2))]),
+           dtypes=F32),
+    OpInfo(name="cat", op=lambda a, b, dim: ltorch.cat([a, b], dim),
+           ref=lambda a, b, dim: jnp.concatenate([a, b], axis=dim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3), dt), make_tensor(rng, (2, 5), dt), 1)),
+               SampleInput((make_tensor(rng, (2, 3), dt), make_tensor(rng, (4, 3), dt), 0)),
+           ]), dtypes=F32),
+    OpInfo(name="stack", op=lambda a, b: ltorch.stack([a, b], 0),
+           ref=lambda a, b: jnp.stack([a, b], axis=0),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3), dt), make_tensor(rng, (2, 3), dt)))]),
+           dtypes=F32),
+    OpInfo(name="split", op=lambda a: ltorch.split(a, 2, 1), ref=lambda a: jnp.split(a, [2, 4], axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]), dtypes=F32),
+    OpInfo(name="flatten", op=ltorch.flatten, ref=lambda a: jnp.reshape(a, (-1,)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt),))]), dtypes=F32),
+    OpInfo(name="unsqueeze", op=ltorch.unsqueeze, ref=lambda a, d: jnp.expand_dims(a, d),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3), dt), 1))]), dtypes=F32),
+    OpInfo(name="squeeze", op=ltorch.squeeze, ref=lambda a: jnp.squeeze(a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 1, 3, 1), dt),))]), dtypes=F32),
+    OpInfo(name="expand", op=lambda a: ltorch.expand(a, (4, 3, 5)), ref=lambda a: jnp.broadcast_to(a, (4, 3, 5)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 1), dt),))]), dtypes=F32),
+    OpInfo(name="flip", op=lambda a: ltorch.flip(a, (0,)), ref=lambda a: jnp.flip(a, 0),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]), dtypes=F32),
+    OpInfo(name="tril", op=ltorch.tril, ref=jnp.tril,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]), dtypes=F32),
+    OpInfo(name="triu", op=ltorch.triu, ref=jnp.triu,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]), dtypes=F32),
+    OpInfo(name="pad", op=lambda a: ltorch.pad(a, (1, 2, 0, 3)),
+           ref=lambda a: jnp.pad(a, ((0, 3), (1, 2))),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]), dtypes=F32),
+    OpInfo(name="getitem_basic", op=lambda a: a[1:3, ::2],
+           ref=lambda a: a[1:3, ::2],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (5, 8), dt),))]), dtypes=F32),
+    OpInfo(name="getitem_int", op=lambda a: a[2],
+           ref=lambda a: a[2],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (5, 8), dt),))]), dtypes=F32),
+    OpInfo(name="getitem_newaxis", op=lambda a: a[None, :, None],
+           ref=lambda a: a[None, :, None],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (5,), dt),))]), dtypes=F32),
+]
+
+
+def matmul_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 5), dtype), make_tensor(rng, (5, 3), dtype)))
+    yield SampleInput((make_tensor(rng, (2, 4, 5), dtype), make_tensor(rng, (2, 5, 3), dtype)))
+    yield SampleInput((make_tensor(rng, (7, 2, 4, 5), dtype), make_tensor(rng, (5, 3), dtype)))
+    yield SampleInput((make_tensor(rng, (5,), dtype), make_tensor(rng, (5, 3), dtype)))
+    yield SampleInput((make_tensor(rng, (4, 5), dtype), make_tensor(rng, (5,), dtype)))
+
+
+nn_opinfos = [
+    OpInfo(name="matmul", op=ltorch.matmul, ref=jnp.matmul, sample_generator=matmul_samples,
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="linear", op=ltorch.linear, ref=lambda x, w, b=None: x @ w.T + (0 if b is None else b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 8), dt), make_tensor(rng, (16, 8), dt))),
+               SampleInput((make_tensor(rng, (2, 4, 8), dt), make_tensor(rng, (16, 8), dt), make_tensor(rng, (16,), dt))),
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="embedding", op=ltorch.embedding,
+           ref=lambda idx, w: jnp.take(w, idx, axis=0),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray(rng.randint(0, 10, (4, 6))), make_tensor(rng, (10, 8), dt)))
+           ]), dtypes=F32_64),
+    OpInfo(name="softmax", op=ltorch.softmax, ref=lambda a, dim=-1: jax.nn.softmax(a, axis=dim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 9), dt),), {"dim": -1}),
+               SampleInput((make_tensor(rng, (2, 3, 5), dt),), {"dim": 1}),
+           ]), dtypes=F32_64, atol=1e-5, rtol=1e-5),
+    OpInfo(name="log_softmax", op=ltorch.log_softmax, ref=lambda a, dim=-1: jax.nn.log_softmax(a, axis=dim),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 9), dt),), {"dim": -1})]),
+           dtypes=F32_64),
+    OpInfo(name="layer_norm",
+           op=lambda x, w, b: ltorch.layer_norm(x, (x.shape[-1],), w, b, 1e-5),
+           ref=lambda x, w, b: _ref_layer_norm(x, w, b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 16), dt), make_tensor(rng, (16,), dt), make_tensor(rng, (16,), dt)))
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="rms_norm",
+           op=lambda x, w: ltorch.rms_norm(x, (x.shape[-1],), w, 1e-6),
+           ref=lambda x, w: _ref_rms_norm(x, w),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 16), dt), make_tensor(rng, (16,), dt)))
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="cross_entropy",
+           op=ltorch.cross_entropy,
+           ref=lambda logits, tgt: _ref_cross_entropy(logits, tgt),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (8, 12), dt), jnp.asarray(rng.randint(0, 12, (8,)))))
+           ]), dtypes=F32_64, atol=1e-5, rtol=1e-5),
+    OpInfo(name="sdpa_causal",
+           op=lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True),
+           ref=lambda q, k, v: _ref_sdpa(q, k, v, causal=True),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 8, 16), dt), make_tensor(rng, (2, 3, 8, 16), dt),
+                            make_tensor(rng, (2, 3, 8, 16), dt)))
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="where", op=ltorch.where, ref=jnp.where,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dtypes.bool8), make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt)))
+           ]), dtypes=F32_64),
+    OpInfo(name="topk", op=lambda a: ltorch.topk(a, 3), ref=lambda a: jax.lax.top_k(a, 3),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 10), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="gather", op=lambda a, idx: ltorch.gather(a, 1, idx),
+           ref=lambda a, idx: jnp.take_along_axis(a, idx, axis=1),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 10), dt), jnp.asarray(rng.randint(0, 10, (4, 3)))))
+           ]), dtypes=F32_64),
+    OpInfo(name="index_select", op=lambda a, idx: ltorch.index_select(a, 0, idx),
+           ref=lambda a, idx: jnp.take(a, idx, axis=0),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (7, 5), dt), jnp.asarray(rng.randint(0, 7, (4,)))))
+           ]), dtypes=F32_64),
+    OpInfo(name="conv2d", op=ltorch.conv2d,
+           ref=lambda x, w: jax.lax.conv_general_dilated(x, w, (1, 1), [(0, 0), (0, 0)],
+                                                         dimension_numbers=("NCHW", "OIHW", "NCHW")),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 8, 8), dt), make_tensor(rng, (4, 3, 3, 3), dt)))
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+]
+
+
+def _ref_layer_norm(x, w, b, eps=1e-5):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.mean((x - m) ** 2, axis=-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * w + b
+
+
+def _ref_rms_norm(x, w, eps=1e-6):
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    return x / jnp.sqrt(ms + eps) * w
+
+
+def _ref_cross_entropy(logits, tgt):
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(lsm, tgt[:, None], axis=1)[:, 0])
+
+
+def _ref_sdpa(q, k, v, causal=False):
+    import math
+
+    d = q.shape[-1]
+    scores = q @ jnp.swapaxes(k, -2, -1) / math.sqrt(d)
+    if causal:
+        L = q.shape[-2]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return jax.nn.softmax(scores, axis=-1) @ v
+
+
+all_opinfos = unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos + nn_opinfos
+grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]

@@ -1,0 +1,203 @@
+"""Core IR tests: traces, symbols, proxies, passes, caching, prologues.
+
+Counterpart of reference thunder/tests/test_core.py (SURVEY.md §4.4)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.proxies import TensorProxy, NumberProxy
+from thunder_tpu.core.trace import TraceCtx, tracectx
+from thunder_tpu.core.transform_common import cse, dce, flatten_to_prims
+from thunder_tpu.ops import clang, ltorch
+
+
+def make_proxy(shape, dtype=dtypes.float32):
+    return TensorProxy(shape=shape, dtype=dtype)
+
+
+class TestTraceConstruction:
+    def test_record_and_print(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            a = make_proxy((2, 3))
+            b = make_proxy((2, 3))
+            c = prims.add(a, b)
+            prims.python_return(c)
+        trc.args = (a, b)
+        src = trc.python()
+        assert "prims.add" in src
+        assert "return" in src
+        assert len(trc.bound_symbols) == 2
+
+    def test_subsymbol_hierarchy(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            a = make_proxy((4,))
+            out = ltorch.softmax(a, 0)
+            prims.python_return(out)
+        trc.args = (a,)
+        top = trc.bound_symbols[0]
+        assert top.sym.name == "softmax"
+        assert len(top.subsymbols) > 0
+        flat = flatten_to_prims(trc)
+        assert all(b.sym.is_prim for b in flat.bound_symbols)
+
+    def test_unique_names(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            ps = [make_proxy((1,)) for _ in range(100)]
+        assert len({p.name for p in ps}) == 100
+
+
+class TestPasses:
+    def _trace_with_dead_code(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            a = make_proxy((2,))
+            live = prims.add(a, a)
+            dead = prims.mul(a, a)  # noqa: F841 — dead
+            prims.python_return(live)
+        trc.args = (a,)
+        return trc
+
+    def test_dce(self):
+        trc = self._trace_with_dead_code()
+        out = dce(trc)
+        names = [b.sym.name for b in out.bound_symbols]
+        assert "mul" not in names
+        assert "add" in names
+
+    def test_cse(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            a = make_proxy((2,))
+            x = prims.add(a, a)
+            y = prims.add(a, a)
+            z = prims.mul(x, y)
+            prims.python_return(z)
+        trc.args = (a,)
+        out = cse(trc)
+        adds = [b for b in out.bound_symbols if b.sym.name == "add"]
+        assert len(adds) == 1
+
+    def test_dont_dce_random(self):
+        trc = TraceCtx(None)
+        with tracectx(trc):
+            a = make_proxy((2,))
+            prims.python_return(prims.add(a, a))
+        trc.args = (a,)
+        assert len(dce(trc).bound_symbols) == 2
+
+
+class TestMetaFunctions:
+    def test_matmul_meta_batched(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((7, 2, 3))
+            b = make_proxy((1, 3, 5))
+            out = prims.matmul(a, b)
+        assert out.shape == (7, 2, 5)
+
+    def test_matmul_meta_vec(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((3,))
+            b = make_proxy((3, 5))
+            assert prims.matmul(a, b).shape == (5,)
+
+    def test_broadcast_shapes(self):
+        assert clang.compute_broadcast_shape((2, 1, 3), (4, 3)) == (2, 4, 3)
+        with pytest.raises(Exception):
+            clang.compute_broadcast_shape((2,), (3,))
+
+    def test_reduction_meta(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((2, 3, 4))
+            assert prims.sum_prim(a, (1,)).shape == (2, 4)
+            assert prims.amax(a, (0, 2)).shape == (3,)
+
+    def test_slice_meta(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((10, 8))
+            out = prims.slice_prim(a, (2, 0), (8, 8), (2, 1))
+            assert out.shape == (3, 8)
+
+    def test_conv_meta(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((1, 3, 32, 32))
+            w = make_proxy((16, 3, 3, 3))
+            out = prims.convolution(a, w, None, (1, 1), (1, 1), (1, 1), 1)
+            assert out.shape == (1, 16, 32, 32)
+
+    def test_elementwise_shape_mismatch_raises(self):
+        with tracectx(TraceCtx(None)):
+            a = make_proxy((2, 3))
+            b = make_proxy((3, 2))
+            with pytest.raises(Exception):
+                prims.add(a, b)
+
+
+class TestTypePromotion:
+    def test_promote(self):
+        assert dtypes.promote_dtypes(dtypes.int32, dtypes.float32) == dtypes.float32
+        assert dtypes.promote_dtypes(dtypes.bfloat16, dtypes.float32) == dtypes.float32
+        assert dtypes.promote_dtypes(dtypes.bfloat16, dtypes.float16) == dtypes.float32
+        assert dtypes.promote_dtypes(dtypes.int8, dtypes.int32) == dtypes.int32
+        assert dtypes.promote_dtypes(dtypes.bool8, dtypes.bool8) == dtypes.bool8
+
+    def test_weak_scalars(self):
+        # python float + int tensor -> float32 result dtype at clang level
+        assert dtypes.promote_dtypes(dtypes.bfloat16, float) == dtypes.bfloat16
+        assert dtypes.promote_dtypes(dtypes.int32, bool) == dtypes.int32
+
+
+class TestJitCaching:
+    def test_cache_hit_and_miss(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            return x * 2.0
+
+        cf = tt.jit(f)
+        x = jnp.ones((2, 2), jnp.float32)
+        cf(x)
+        cf(x)
+        assert cf.cache_hits == 1 and cf.cache_misses == 1
+        assert len(calls) == 1  # traced once
+        cf(jnp.ones((3, 3), jnp.float32))  # new shape -> retrace
+        assert cf.cache_misses == 2
+
+    def test_prologue_validates(self):
+        def f(x):
+            return x + 1.0
+
+        cf = tt.jit(f)
+        out = cf(jnp.zeros((2,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [1.0, 1.0])
+
+    def test_static_number_respecialization(self):
+        def f(x, n):
+            return x * n
+
+        cf = tt.jit(f)
+        a = jnp.ones((2,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(cf(a, 2.0)), [2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(cf(a, 3.0)), [3.0, 3.0])
+        assert cf.cache_misses == 2
+
+    def test_last_traces(self):
+        cf = tt.jit(lambda x: x + x)
+        cf(jnp.ones((2,)))
+        trcs = tt.last_traces(cf)
+        assert len(trcs) >= 2
+        assert "def" in trcs[-1].python()
+
+
+class TestNumberProxy:
+    def test_static_arithmetic(self):
+        n = NumberProxy(3, int, name="n_test")
+        assert n + 1 == 4
+        assert n * 2 == 6
+        assert int(n) == 3
+        assert bool(NumberProxy(0, int, name="n_t2")) is False

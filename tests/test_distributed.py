@@ -1,0 +1,156 @@
+"""Distributed strategy tests on the virtual 8-device CPU mesh.
+
+Counterpart of reference thunder/tests/distributed/ (test_ddp.py,
+test_fsdp.py, test_tensor_parallel.py — which spawn real NCCL processes,
+helper.py:146). Here the same shard_map path that runs on TPU meshes executes
+on 8 virtual CPU devices, so strategies are validated against the
+single-device training trajectory exactly."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+from thunder_tpu.parallel import ddp, fsdp, make_mesh
+from thunder_tpu.parallel.context_parallel import context_parallel
+from thunder_tpu.parallel.tensor_parallel import column_parallel, row_parallel
+from thunder_tpu.training import TrainStep
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+class LossMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64, seed=1)
+        self.fc2 = nn.Linear(64, 8, seed=2)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc2(ltorch.gelu(self.fc1(x))), y)
+
+
+@pytest.fixture(scope="module")
+def reference():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 16), jnp.float32)
+    y = jnp.zeros((16, 8), jnp.float32)
+    m = LossMLP()
+    sd = {k: np.asarray(v).copy() for k, v in m.state_dict().items()}
+    step = TrainStep(m, optim.AdamW(lr=1e-2))
+    losses = [float(step(x, y)) for _ in range(4)]
+    return x, y, sd, losses
+
+
+def _run(strategy, x, y, sd, steps=4):
+    m = LossMLP()
+    m.load_state_dict(sd)
+    tm = tt.jit(m)
+    if strategy == "ddp":
+        ddp(tm, make_mesh({"dp": 8}))
+    elif strategy == "fsdp":
+        fsdp(tm, make_mesh({"fsdp": 8}), min_shard_numel=1)
+    elif strategy == "2d":
+        mesh = make_mesh({"dp": 2, "fsdp": 4})
+        ddp(tm, mesh)
+        fsdp(tm, mesh, min_shard_numel=1)
+    elif strategy == "tp":
+        mesh = make_mesh({"tp": 8})
+        column_parallel(tm, mesh, ["fc1"])
+        row_parallel(tm, mesh, ["fc2"])
+    step = TrainStep(tm, optim.AdamW(lr=1e-2))
+    return [float(step(x, y)) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("strategy", ["ddp", "fsdp", "2d", "tp"])
+def test_strategy_matches_single_device(strategy, reference):
+    x, y, sd, ref_losses = reference
+    losses = _run(strategy, x, y, sd)
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-4)
+
+
+def test_fsdp_param_shards_placed():
+    m = LossMLP()
+    tm = tt.jit(m)
+    mesh = make_mesh({"fsdp": 8})
+    fsdp(tm, mesh)  # default min_shard_numel: small params stay replicated
+    plan = tm._dist_plan
+    kinds = {k: v[0].kind for k, v in plan.param_strategies.items()}
+    assert kinds["fc1.weight"] == "shard0"  # 64x16=1024 elems, 64 % 8 == 0
+    assert kinds["fc2.bias"] == "replicate"  # tiny param
+    # placement actually applied
+    w = dict(tm.named_parameters())["fc1.weight"].data
+    assert w.sharding is not None
+
+
+def test_collective_prims_in_trace(reference):
+    x, y, sd, _ = reference
+    m = LossMLP()
+    m.load_state_dict(sd)
+    tm = tt.jit(m)
+    fsdp(tm, make_mesh({"fsdp": 8}), min_shard_numel=1)
+    step = TrainStep(tm, optim.AdamW(lr=1e-2))
+    step(x, y)
+    fwd_src = step._vag._cs.last_traces[-1].python()
+    bwd_src = step._vag._cs.last_backward_traces[-1].python()
+    # the collective prims are IR-visible before fusion
+    acquired = step._vag._cs.last_traces[0].python()
+    assert "all_gather" in acquired
+    bwd_acquired = step._vag._cs.last_backward_traces[0].python()
+    assert "reduce_scatter" in bwd_acquired
+
+
+def test_ring_attention_matches_sdpa():
+    import math
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from thunder_tpu.parallel.context_parallel import _ring_attention_impl
+
+    B, H, T, D = 2, 3, 32, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+
+    def ref_sdpa(q, k, v):
+        s = q @ jnp.swapaxes(k, -2, -1) / math.sqrt(D)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        return jax.nn.softmax(s, -1) @ v
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("sp",))
+    ring = jax.jit(jax.shard_map(
+        lambda q, k, v: _ring_attention_impl(q, k, v, axis="sp", causal=True, world_size=4),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+        out_specs=P(None, None, "sp"), check_vma=False))
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref_sdpa(q, k, v)), atol=1e-5)
+
+
+def test_context_parallel_gpt_exact():
+    from thunder_tpu.models.litgpt import Config, GPT
+
+    rng = np.random.RandomState(0)
+    cfg = Config.from_name("tiny", block_size=128, n_layer=1)
+
+    class Probe(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.gpt = GPT(cfg)
+
+        def forward(self, idx, w):
+            return ltorch.mean(self.gpt(idx) * w)
+
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 128)))
+    w = jnp.asarray(rng.randn(2, 128, cfg.padded_vocab_size), jnp.float32)
+    m0 = Probe()
+    sd = {k: np.asarray(v).copy() for k, v in m0.state_dict().items()}
+    ref = float(TrainStep(m0, optim.SGD(lr=0.0))(idx, w))
+    m1 = Probe()
+    m1.load_state_dict(sd)
+    tm1 = tt.jit(m1)
+    context_parallel(tm1, make_mesh({"sp": 4}))
+    cp = float(TrainStep(tm1, optim.SGD(lr=0.0))(idx, w))
+    assert abs(ref - cp) / max(1e-9, abs(ref)) < 1e-4

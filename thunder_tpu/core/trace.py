@@ -106,6 +106,8 @@ class TraceCtx(baseutils.TraceInterface):
         if self._name:
             return self._name
         base = getattr(self.fn, "__name__", None) or "computation"
+        if not base.isidentifier():  # e.g. "<lambda>"
+            base = "computation"
         return "prologue" if self.is_prologue else base
 
     # ---- printing ----

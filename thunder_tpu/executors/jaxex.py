@@ -142,8 +142,12 @@ def _scatter_add_general(a, indices, value, dim):
 
 
 _reg(PrimIDs.SCATTER_ADD, _scatter_add_general)
-_reg(PrimIDs.DYNAMIC_SLICE, lambda a, start_indices, slice_sizes: lax.dynamic_slice(a, start_indices, slice_sizes))
-_reg(PrimIDs.DYNAMIC_UPDATE_SLICE, lambda a, update, start_indices: lax.dynamic_update_slice(a, update, start_indices))
+def _norm_idx(start_indices):
+    return tuple(jnp.asarray(i, jnp.int32) for i in start_indices)
+
+
+_reg(PrimIDs.DYNAMIC_SLICE, lambda a, start_indices, slice_sizes: lax.dynamic_slice(a, _norm_idx(start_indices), slice_sizes))
+_reg(PrimIDs.DYNAMIC_UPDATE_SLICE, lambda a, update, start_indices: lax.dynamic_update_slice(a, update, _norm_idx(start_indices)))
 
 # ---- elementwise unary ----
 _unary_impls = {

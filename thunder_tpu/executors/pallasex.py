@@ -1,0 +1,425 @@
+"""pallasex: hand-written Pallas(Mosaic) TPU kernels for the hot ops.
+
+The TPU analog of the reference's kernel executors — sdpaex/cudnnex/fa3ex
+flash attention (thunder/executors/sdpaex.py:1, cudnn_sdpa.py:1, fa3ex.py:1),
+apex/triton fused cross-entropy (apex_entropyex_impl.py:1,
+triton_crossentropy_impl.py:1) and fused RMSNorm
+(apex_fused_rms_norm_impl.py:1). Kernels follow the Pallas TPU playbook:
+(8,128)+ tiles, f32 accumulation in VMEM scratch, online softmax for flash
+attention.
+
+The executor claims the composite ltorch symbols whole (`sdpa`,
+`cross_entropy`, `rms_norm`) via checkers; autodiff uses the executor-claimed
+grad path (flash fwd saves (o, lse); flash bwd recomputes blockwise) — the
+reference's executor-claimed-grads mechanism (thunder/transforms/autodiff.py:28-40)."""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas namespace; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from ..core import dtypes
+from ..core.proxies import TensorProxy
+from ..core.symbol import OpTags, Symbol
+from ..extend import OperatorExecutor, register_executor
+
+ex = OperatorExecutor("pallas")
+register_executor(ex)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
+def _interpret() -> bool:
+    return not _on_tpu()
+
+
+# ===========================================================================
+# Flash attention — forward
+# ===========================================================================
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool,
+                      scale: float, q_offset_blocks: int):
+    # q_ref: (block_q, D); k_ref/v_ref: (T, D); o_ref: (block_q, D); lse_ref: (block_q, 1)
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    qi = pl.program_id(2)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        o_acc, m, l = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        o_new = o_acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    n_k = T // block_k
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_k, body, (o0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
+
+
+def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
+                            block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    """q,k,v: (B, H, T, D) -> (o, lse). D must be a multiple of 128 (lane dim)."""
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    grid = (B, H, T // block_q)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+                          q_offset_blocks=0),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# ===========================================================================
+# Flash attention — backward (recompute blockwise; dq kernel + dkv kernel)
+# ===========================================================================
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                         block_k: int, causal: bool, scale: float):
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq_acc):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, T // block_k, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                          block_q: int, causal: bool, scale: float):
+    block_k, D = k_ref.shape
+    T = q_ref.shape[0]
+    ki = pl.program_id(2)
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        s = jax.lax.dot_general(q * scale, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc = dk_acc + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, T // block_q, body, (z, z))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=None,
+                             block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (B,H,T)
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=(B, H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta4)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        grid=(B, H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta4)
+    return dq, dk, dv
+
+
+def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None) -> bool:
+    """Checker: pallas flash attention claims sdpa when shapes fit the tiling."""
+    if attn_mask is not None or (dropout_p and dropout_p > 0.0):
+        return False
+    shapes_ok = (
+        getattr(q, "ndim", 0) == 4
+        and q.shape[-1] % 128 == 0
+        and q.shape[-2] % DEFAULT_BLOCK_Q == 0
+        and k.shape[-2] % DEFAULT_BLOCK_K == 0
+        and q.shape[-2] == k.shape[-2]
+    )
+    return bool(shapes_ok)
+
+
+# symbol registration: claims ltorch.sdpa whole ------------------------------
+
+
+def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    o, _ = flash_attention_forward(q, k, v, causal=is_causal, scale=scale)
+    return o
+
+
+ex.register_implementation(
+    "torch.nn.functional.scaled_dot_product_attention",
+    _sdpa_flash_impl,
+    checker=flash_attention_supported,
+)
+
+
+def _register_sdpa_grad_rule():
+    """Executor-claimed grad: flash fwd saves (o, lse, q, k, v); flash bwd
+    recomputes probabilities blockwise. Falls through to the composite
+    decomposition when the kernel can't claim the shapes."""
+    from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+    def fwd_meta(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+        o = TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device)
+        lse = TensorProxy(shape=q.shape[:-1], dtype=dtypes.float32, device=q.device)
+        return o, lse
+
+    def fwd_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+        return flash_attention_forward(q, k, v, causal=is_causal, scale=scale)
+
+    flash_fwd_sym = Symbol("flash_attention_fwd", fwd_meta, id="pallas.flash_attention_fwd",
+                           is_prim=True, module="pallas", executor=ex)
+    ex.opmap[flash_fwd_sym.id] = fwd_impl
+
+    def bwd_meta(q, k, v, o, lse, causal, scale, do):
+        return (TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device),
+                TensorProxy(shape=k.shape, dtype=k.dtype, device=k.device),
+                TensorProxy(shape=v.shape, dtype=v.dtype, device=v.device))
+
+    def bwd_impl(q, k, v, o, lse, causal, scale, do):
+        return flash_attention_backward(q, k, v, o, lse, do, causal=causal, scale=scale)
+
+    flash_bwd_sym = Symbol("flash_attention_bwd", bwd_meta, id="pallas.flash_attention_bwd",
+                           is_prim=True, module="pallas", executor=ex)
+    ex.opmap[flash_bwd_sym.id] = bwd_impl
+
+    @register_augmented_forward("torch.nn.functional.scaled_dot_product_attention")
+    def _sdpa_aug(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+        if not flash_attention_supported(q, k, v, attn_mask, dropout_p, is_causal, scale):
+            return NotImplemented
+        o, lse = flash_fwd_sym(q, k, v, attn_mask, dropout_p, is_causal, scale)
+        return VJPResult(o, (q, k, v, o, lse, bool(is_causal), scale))
+
+    @register_backward("torch.nn.functional.scaled_dot_product_attention")
+    def _sdpa_bwd(q, k, v, o, lse, causal, scale, do):
+        return flash_bwd_sym(q, k, v, o, lse, causal, scale, do)
+
+
+_register_sdpa_grad_rule()
+
+
+# ===========================================================================
+# Fused cross-entropy (mean reduction over valid targets)
+# ===========================================================================
+
+
+def _xent_kernel(logits_ref, tgt_ref, loss_ref, lse_ref):
+    # logits (block_n, V), tgt (block_n, 1) int32
+    logits = logits_ref[:].astype(jnp.float32)
+    n, V = logits.shape
+    m = jnp.max(logits, axis=1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=1))
+    tgt = tgt_ref[:][:, 0]
+    onehot = jax.lax.broadcasted_iota(jnp.int32, (n, V), 1) == tgt[:, None]
+    picked = jnp.sum(jnp.where(onehot, logits, 0.0), axis=1)
+    loss_ref[:] = (lse - picked)[:, None]
+    lse_ref[:] = lse[:, None]
+
+
+def fused_cross_entropy_forward(logits, targets, block_n: int = 8):
+    N, V = logits.shape
+    block_n = min(block_n, N)
+    tgt2 = targets.astype(jnp.int32)[:, None]
+    loss, lse = pl.pallas_call(
+        _xent_kernel,
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, V), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(logits, tgt2)
+    return loss[:, 0], lse[:, 0]
+
+
+def _xent_supported(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    return (
+        weight is None and label_smoothing == 0.0 and reduction == "mean"
+        and getattr(logits, "ndim", 0) == 2
+        and logits.shape[0] % 8 == 0 and logits.shape[1] % 128 == 0
+    )
+
+
+def _xent_impl(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    loss, _ = fused_cross_entropy_forward(logits, target)
+    valid = (target != ignore_index)
+    loss = jnp.where(valid, loss, 0.0)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+
+
+ex.register_implementation("torch.nn.functional.cross_entropy", _xent_impl, checker=_xent_supported)
+
+
+# ===========================================================================
+# Fused RMSNorm
+# ===========================================================================
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=1, keepdims=True)
+    w = w_ref[:].astype(jnp.float32)  # (1, D) broadcasts over rows
+    o_ref[:] = ((x * jax.lax.rsqrt(ms + eps)) * w).astype(o_ref.dtype)
+
+
+def fused_rms_norm(x2d, w, eps: float = 1e-6, block_n: int = 256):
+    N, D = x2d.shape
+    block_n = min(block_n, N)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, D), x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, w[None, :])
+
+
+def _rms_supported(a, normalized_shape, weight=None, eps=1e-6):
+    return (
+        weight is not None and len(normalized_shape) == 1
+        and getattr(a, "ndim", 0) >= 2 and a.shape[-1] % 128 == 0
+    )
+
+
+def _rms_impl(a, normalized_shape, weight=None, eps=1e-6):
+    shape = a.shape
+    x2d = a.reshape((-1, shape[-1]))
+    out = fused_rms_norm(x2d, weight, eps)
+    return out.reshape(shape)
+
+
+ex.register_implementation("torch.nn.functional.rms_norm", _rms_impl, checker=_rms_supported)

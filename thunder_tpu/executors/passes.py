@@ -61,6 +61,13 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
             for sub in bsym.subsymbols:
                 lower(sub)
             return
+        if not bsym.sym.is_prim:
+            # composite that recorded nothing: a pure pass-through (e.g. a
+            # full-range getitem) — outputs are existing proxies, nothing to run
+            out_names = {o.name for o in bsym.flat_proxy_outs()}
+            in_names = {a.name for a in bsym.flat_proxy_args()}
+            if out_names <= in_names:
+                return
         raise RuntimeError(
             f"no executor can run {bsym.sym.name} (id={bsym.sym.id}); "
             f"tried {[e.name for e in executors]}"

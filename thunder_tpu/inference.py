@@ -1,0 +1,209 @@
+"""Inference engine: KV-cached autoregressive generation.
+
+Capability counterpart of the reference's inference stack
+(thunder/benchmarks/benchmark_inference.py:1-11: throughput, ms/token, TTFT,
+TBOT; HF generate via thunder.jit + CUDA graphs). TPU-native design:
+
+  - static shapes: the KV cache is a fixed (B, H, max_seq, D) buffer updated
+    with dynamic_update_slice; prefill and decode are two cached trace
+    specializations (the role CUDA graphs play in the reference is played by
+    XLA whole-program compilation — each decode step is ONE dispatch).
+  - the decode step is compiled once and reused for every token.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import nn
+from .ops import clang, ltorch
+
+
+@dataclass
+class GenerationMetrics:
+    """TTFT/TBOT/throughput, mirroring the reference harness metrics."""
+
+    ttft_s: float = 0.0
+    tbot_s: float = 0.0
+    tokens_per_sec: float = 0.0
+    ms_per_token: float = 0.0
+    n_new_tokens: int = 0
+
+
+class KVCache:
+    """Per-layer static-shape KV cache."""
+
+    def __init__(self, n_layer: int, batch: int, n_kv_heads: int, max_seq: int, head_dim: int, dtype=jnp.bfloat16):
+        shape = (batch, n_kv_heads, max_seq, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(n_layer)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(n_layer)]
+
+    def as_tuple(self):
+        return tuple(self.k), tuple(self.v)
+
+
+def cached_sdpa(q, k_cache, v_cache, pos, scale=None):
+    """Attention against the cache prefix [0, pos+q_len); pos may be a traced
+    scalar so the same compiled decode step serves every position."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kt = clang.matrix_transpose(k_cache)
+    scores = ltorch.matmul(q, kt) * scale
+    Lq = q.shape[-2]
+    Lk = k_cache.shape[-2]
+    import jax.numpy as _jnp
+
+    q_pos = clang.ensure_proxy(_jnp.arange(Lq, dtype=_jnp.int32))
+    if isinstance(pos, int):
+        q_pos = q_pos + pos
+    else:
+        q_pos = q_pos + ltorch.reshape(pos, (1,))
+    k_pos = clang.ensure_proxy(_jnp.arange(Lk, dtype=_jnp.int32))
+    mask = ltorch.le(clang.unsqueeze(k_pos, 0), clang.unsqueeze(q_pos, 1))
+    scores = ltorch.where(mask, scores, float("-inf"))
+    probs = ltorch.softmax(scores, -1)
+    probs = clang.maybe_convert_to_dtype(probs, v_cache.dtype)
+    return ltorch.matmul(probs, v_cache)
+
+
+class GPTInference:
+    """Greedy/temperature generation over a models.litgpt.GPT.
+
+    The model's sdpa path is swapped for cache-aware attention by running the
+    blocks manually (the GPT module structure is reused; no retracing of the
+    whole prefix per token)."""
+
+    def __init__(self, gpt, *, max_seq: Optional[int] = None, dtype=jnp.bfloat16):
+        from . import jit as _jit
+
+        self.gpt = gpt
+        cfg = gpt.cfg
+        self.cfg = cfg
+        self.max_seq = max_seq or cfg.block_size
+        self.dtype = dtype
+        self._decode_cfn = None
+        self._prefill_cfn = None
+
+    # --- functional single-step over the module tree ---
+    def _forward_cached(self, idx, ks, vs, pos):
+        """idx (B, T); ks/vs per-layer cache tuples; pos: start position —
+        either a python int (prefill) or a scalar int32 tensor (decode, so one
+        compiled decode step serves every position)."""
+        from .core import prims
+
+        cfg = self.cfg
+        gpt = self.gpt
+        B, T = idx.shape
+        n_elem = cfg.rope_n_elem
+        cos_full = clang.ensure_proxy(gpt.cos)
+        sin_full = clang.ensure_proxy(gpt.sin)
+        cos = prims.dynamic_slice(cos_full, (pos, 0), (T, n_elem))
+        sin = prims.dynamic_slice(sin_full, (pos, 0), (T, n_elem))
+        x = gpt.wte(idx)
+        new_ks, new_vs = [], []
+        for li, block in enumerate(gpt.h):
+            x_n = block.norm_1(x)
+            att = block.attn
+            nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+            qkv = att.attn(x_n)
+            q_per_kv = nh // ng
+            qkv = ltorch.reshape(qkv, (B, T, ng, q_per_kv + 2, hs))
+            q = ltorch.reshape(qkv[:, :, :, :q_per_kv, :], (B, T, nh, hs))
+            k = ltorch.reshape(qkv[:, :, :, q_per_kv: q_per_kv + 1, :], (B, T, ng, hs))
+            v = ltorch.reshape(qkv[:, :, :, q_per_kv + 1:, :], (B, T, ng, hs))
+            q = ltorch.permute(q, (0, 2, 1, 3))
+            k = ltorch.permute(k, (0, 2, 1, 3))
+            v = ltorch.permute(v, (0, 2, 1, 3))
+            from .models.litgpt import _apply_rope, _repeat_kv
+
+            q = _apply_rope(q, cos, sin, cfg.rope_n_elem)
+            k = _apply_rope(k, cos, sin, cfg.rope_n_elem)
+            # insert into cache at pos
+            from .core import prims
+
+            k_cache = prims.dynamic_update_slice(ks[li], k, (0, 0, pos, 0))
+            v_cache = prims.dynamic_update_slice(vs[li], v, (0, 0, pos, 0))
+            new_ks.append(k_cache)
+            new_vs.append(v_cache)
+            kq = _repeat_kv(k_cache, q_per_kv) if ng != nh else k_cache
+            vq = _repeat_kv(v_cache, q_per_kv) if ng != nh else v_cache
+            y = cached_sdpa(q, kq, vq, pos)
+            y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * hs))
+            h = att.proj(y)
+            if cfg.parallel_residual:
+                x = x + h + block.mlp(block.norm_2(x))
+            else:
+                x = x + h
+                x = x + block.mlp(block.norm_2(x))
+        x = gpt.ln_f(x)
+        logits = gpt.lm_head(x[:, -1])  # only last position needed for generation
+        return logits, tuple(new_ks), tuple(new_vs)
+
+    def _build(self, B: int, prompt_len: int):
+        from . import jit as _jit
+        from .nn.module import functional_params
+
+        gpt = self.gpt
+        cfg = self.cfg
+
+        def prefill(params, idx, ks, vs):
+            with functional_params(gpt, params):
+                return self._forward_cached(idx, ks, vs, 0)
+
+        def decode(params, idx, ks, vs, pos):
+            with functional_params(gpt, params):
+                return self._forward_cached(idx, ks, vs, pos)
+
+        prefill.__name__ = "prefill"
+        decode.__name__ = "decode"
+        self._prefill_cfn = _jit(prefill)
+        self._decode_cfn = _jit(decode)
+
+    def generate(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
+                 collect_metrics: bool = False):
+        """prompt: (B, T) int array. Returns (tokens (B, T+max_new), metrics)."""
+        cfg = self.cfg
+        B, T = prompt.shape
+        if self._decode_cfn is None:
+            self._build(B, T)
+        params = {k: p for k, p in self.gpt.named_parameters()}
+        cache = KVCache(cfg.n_layer, B, cfg.n_query_groups, self.max_seq, cfg.head_size, self.dtype)
+        ks, vs = cache.as_tuple()
+
+        t_start = time.perf_counter()
+        logits, ks, vs = self._prefill_cfn(params, prompt, ks, vs)
+        next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+        jax.block_until_ready(next_tok)
+        ttft = time.perf_counter() - t_start
+
+        toks = [next_tok]
+        pos = T
+        t_decode = time.perf_counter()
+        for _ in range(max_new_tokens - 1):
+            logits, ks, vs = self._decode_cfn(params, next_tok[:, None], ks, vs,
+                                              jnp.asarray(pos, jnp.int32))
+            if temperature > 0.0:
+                key = jax.random.PRNGKey(pos)
+                next_tok = jax.random.categorical(key, logits / temperature, -1).astype(prompt.dtype)
+            else:
+                next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+            toks.append(next_tok)
+            pos += 1
+        jax.block_until_ready(next_tok)
+        dt = time.perf_counter() - t_decode
+
+        out = jnp.concatenate([prompt] + [t[:, None] for t in toks], axis=1)
+        metrics = GenerationMetrics(
+            ttft_s=ttft,
+            tbot_s=dt / max(1, max_new_tokens - 1),
+            tokens_per_sec=B * max_new_tokens / (ttft + dt),
+            ms_per_token=1e3 * (ttft + dt) / max_new_tokens,
+            n_new_tokens=max_new_tokens,
+        )
+        return out, metrics

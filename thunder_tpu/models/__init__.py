@@ -1,0 +1,1 @@
+from . import litgpt, moe, nanogpt, vit

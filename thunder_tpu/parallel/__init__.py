@@ -1,0 +1,17 @@
+"""Distributed/parallel subsystem: mesh, collective prims, strategy transforms.
+
+Reference counterpart: thunder/distributed/ (SURVEY.md §2.6) — rebuilt on
+jax.sharding meshes + XLA collectives instead of torch.distributed NCCL."""
+from .mesh import (
+    DP_AXIS,
+    EP_AXIS,
+    FSDP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    axis_size,
+    make_mesh,
+    single_device_mesh,
+)
+from . import prims
+from .transforms import DDPTransform, DistPlan, FSDPTransform, ParamStrategy, ddp, fsdp

@@ -1,0 +1,158 @@
+"""Context (sequence) parallelism: ring attention over the `sp` mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.6: absent) —
+this is a TPU-first extension built the way the survey recommends (§5): a
+trace transform that swaps `sdpa` bsyms for a ring-attention operator, with
+K/V blocks rotated around the mesh ring via `ppermute` while a flash-style
+online softmax accumulates partial attention (blockwise attention: Liu et al.,
+Ring Attention with Blockwise Transformers, 2023).
+
+Per-device view (inside shard_map): q/k/v arrive sequence-sharded
+(B, H, T/k, D). Each of the k ring steps overlaps the (q @ k_blk) compute
+with the ICI transfer of the next K/V block — XLA's latency-hiding scheduler
+does the overlap because ppermute has no data dependence on the current
+step's matmuls."""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.proxies import TensorProxy
+from ..core.symbol import OpTags, Symbol
+from ..core.trace_interpreter import substitute_symbols
+from ..core.transform_common import Transform
+from ..executors.jaxex import ex as jax_ex
+from ..transforms import autodiff
+from .mesh import SP_AXIS
+
+
+def _ring_attention_meta(q, k, v, *, axis, causal=True, scale=None, world_size=1):
+    return TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device)
+
+
+def _ring_attention_impl(q, k, v, *, axis, causal=True, scale=None, world_size=1):
+    """Blockwise ring attention with online softmax. q,k,v: (B, H, T_loc, D)."""
+    B, H, T, D = q.shape
+    n = world_size
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    my = lax.axis_index(axis)
+
+    qf = q.astype(jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    q_pos = my * T + jnp.arange(T)  # global positions of local queries
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - i) % n  # which device's block we currently hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            mask = k_pos[None, :] <= q_pos[:, None]  # (Tq, Tk) global causal
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # exp(-inf - -inf) guard: rows with no valid keys keep m=-inf
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        m = m_new
+        # rotate K/V around the ring for the next step
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (o, m, l, k_blk, v_blk), None
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+ring_attention = Symbol(
+    "ring_attention",
+    _ring_attention_meta,
+    id="dist.ring_attention",
+    is_prim=True,
+    module="dist",
+    tags=(OpTags.COLLECTIVE, OpTags.DONT_FUSE),
+)
+jax_ex.register_implementation(ring_attention.id, _ring_attention_impl)
+# gradient via jax.vjp of the pure-jax impl (scan+ppermute are reverse-differentiable)
+autodiff.JAX_VJP_FALLBACK.add(ring_attention.id)
+
+
+# ambient sequence-parallel tracing context: set while the model is traced
+# under context parallelism so position-dependent code (rope caches) can
+# offset by the device's sequence-block index.
+from contextvars import ContextVar
+
+_seq_parallel_ctx: ContextVar = ContextVar("seq_parallel_ctx", default=None)
+
+
+def current_seq_parallel_ctx():
+    """(axis, world_size) when tracing under context parallelism, else None."""
+    return _seq_parallel_ctx.get()
+
+
+class seq_parallel_tracing:
+    def __init__(self, axis: str, world_size: int):
+        self.value = (axis, world_size)
+
+    def __enter__(self):
+        self._tok = _seq_parallel_ctx.set(self.value)
+        return self
+
+    def __exit__(self, *exc):
+        _seq_parallel_ctx.reset(self._tok)
+
+
+class ContextParallelTransform(Transform):
+    """Swap every sdpa bsym for ring_attention over the `sp` axis.
+
+    Follows the survey's recommendation (SURVEY.md §5 long-context) that CP be
+    'just another trace transform' in this architecture."""
+
+    def __init__(self, axis: str = SP_AXIS, world_size: int = 1):
+        self.axis = axis
+        self.world_size = world_size
+
+    def transform_traces_pre_autodiff(self, prologue_trc, computation_trc, *, compile_data=None):
+        axis, n = self.axis, self.world_size
+
+        def repl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+            assert attn_mask is None, "context parallel sdpa does not support explicit masks yet"
+            return ring_attention(q, k, v, axis=axis, causal=is_causal, scale=scale, world_size=n)
+
+        new_trc = substitute_symbols(
+            computation_trc,
+            {"torch.nn.functional.scaled_dot_product_attention": repl},
+            provenance=f"Context parallel (ring attention over '{axis}')",
+        )
+        return prologue_trc, new_trc
+
+
+def context_parallel(tmodule, mesh, *, axis: str = SP_AXIS):
+    """Enable ring-attention context parallelism on a ThunderModule: the batch
+    sequence dim is sharded over `axis` and attention runs blockwise around
+    the ring. Compose with ddp/fsdp for 2-D (data × sequence) meshes."""
+    from .mesh import axis_size
+    from .transforms import DistPlan, ParamStrategy, _get_plan, _set_plan
+
+    n = axis_size(mesh, axis)
+    plan = _get_plan(tmodule) or DistPlan(mesh)
+    new = DistPlan(mesh, {}, (), None, (axis,))
+    for name, p in tmodule.get_parameters().items():
+        new.param_strategies.setdefault(name, [ParamStrategy("replicate", axis)])
+    plan = plan.merge(new)
+    _set_plan(tmodule, plan)
+    tmodule._cfn._transforms.append(ContextParallelTransform(axis, n))
+    return tmodule

@@ -1,0 +1,62 @@
+"""Device-mesh abstraction over ICI/DCN.
+
+The reference's communication substrate is torch.distributed process groups
+(thunder/distributed/__init__.py:57-75). TPU-native, the substrate is a
+``jax.sharding.Mesh`` with named axes; collectives become XLA collective ops
+over ICI (intra-slice) / DCN (inter-slice) and overlap is handled by XLA's
+latency-hiding scheduler rather than explicit stream/wait sorting
+(reference thunder/distributed/utils.py:120 sort_waits)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# canonical axis names (reference analog: process-group kinds)
+DP_AXIS = "dp"        # replicated data parallel (DDP)
+FSDP_AXIS = "fsdp"    # sharded data parallel (ZeRO)
+TP_AXIS = "tp"        # tensor parallel
+SP_AXIS = "sp"        # sequence/context parallel
+EP_AXIS = "ep"        # expert parallel
+PP_AXIS = "pp"        # pipeline parallel
+
+
+def make_mesh(axis_sizes: dict[str, int], *, devices=None) -> Mesh:
+    """Build a named mesh: make_mesh({'fsdp': 8}) or {'dp':2,'tp':4}.
+
+    Axis order follows dict order; put DCN-crossing axes first and
+    ICI-heavy axes (tp/sp) last so they land on contiguous devices."""
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1,)), (DP_AXIS,))
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def param_sharding(mesh: Mesh, axis: str, ndim: int, dim: int = 0) -> NamedSharding:
+    spec = [None] * ndim
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_leading(mesh: Mesh, axis: str, ndim: int) -> NamedSharding:
+    return param_sharding(mesh, axis, ndim, 0)

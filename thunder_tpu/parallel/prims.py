@@ -1,0 +1,306 @@
+"""Collective primitives: first-class IR symbols lowering to jax.lax collectives.
+
+Re-design of reference thunder/distributed/prims.py:21-551. The reference's
+collectives wrap torch.distributed NCCL calls and return FutureTensorProxy
+resolved by `wait`; here they lower to XLA collectives with mesh axis names
+(valid inside shard_map regions). XLA's latency-hiding scheduler performs the
+async overlap the reference gets from NCCL side-streams + sort_waits, so
+`wait` is an identity kept for API parity.
+
+The fwd/bwd pairs mirror reference prims.py:376-420:
+  synchronize (DDP):      fwd identity            / bwd all_reduce(sum)
+  all_gather (FSDP):      fwd all-gather dim0     / bwd reduce-scatter(sum)
+  tp input sync (column): fwd identity            / bwd all_reduce
+  tp output sync (row):   fwd all_reduce          / bwd identity
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import dtypes
+from ..core.proxies import FutureTensorProxy, TensorProxy
+from ..core.symbol import OpTags, Symbol
+from ..executors.jaxex import ex as jax_ex
+from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+_COLL_TAGS = (OpTags.COLLECTIVE,)
+
+
+def _axes_tuple(axis):
+    return tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+
+
+def _axsize(axis) -> str:
+    return axis
+
+
+def _make_coll(name: str, meta, impl, vjp=None) -> Symbol:
+    sym = Symbol(name, meta, id=f"dist.{name}", is_prim=True, module="dist", tags=_COLL_TAGS)
+    jax_ex.register_implementation(sym.id, impl)
+    return sym
+
+
+# ---------------------------------------------------------------------------
+# all_gather (dim 0, tiled) — FSDP unshard
+# ---------------------------------------------------------------------------
+
+
+def _all_gather_meta(x: TensorProxy, axis, *, world_size: int):
+    shape = (x.shape[0] * world_size,) + x.shape[1:]
+    return TensorProxy(shape=shape, dtype=x.dtype, device=x.device)
+
+
+def _all_gather_impl(x, axis, *, world_size: int):
+    return lax.all_gather(x, _axes_tuple(axis), tiled=True)
+
+
+all_gather = _make_coll("all_gather", _all_gather_meta, _all_gather_impl)
+
+
+@register_augmented_forward(all_gather.id)
+def _all_gather_aug(x, axis, *, world_size):
+    return VJPResult(all_gather(x, axis, world_size=world_size), (axis, world_size))
+
+
+@register_backward(all_gather.id)
+def _all_gather_bwd(axis, world_size, g):
+    return reduce_scatter(g, axis, world_size=world_size)
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter (sum, dim 0) — FSDP grad sync
+# ---------------------------------------------------------------------------
+
+
+def _reduce_scatter_meta(x: TensorProxy, axis, *, world_size: int):
+    assert x.shape[0] % world_size == 0, f"reduce_scatter dim0 {x.shape[0]} % {world_size}"
+    shape = (x.shape[0] // world_size,) + x.shape[1:]
+    return TensorProxy(shape=shape, dtype=x.dtype, device=x.device)
+
+
+def _reduce_scatter_impl(x, axis, *, world_size: int):
+    return lax.psum_scatter(x, _axes_tuple(axis), scatter_dimension=0, tiled=True)
+
+
+reduce_scatter = _make_coll("reduce_scatter", _reduce_scatter_meta, _reduce_scatter_impl)
+
+
+@register_augmented_forward(reduce_scatter.id)
+def _reduce_scatter_aug(x, axis, *, world_size):
+    return VJPResult(reduce_scatter(x, axis, world_size=world_size), (axis, world_size))
+
+
+@register_backward(reduce_scatter.id)
+def _reduce_scatter_bwd(axis, world_size, g):
+    return all_gather(g, axis, world_size=world_size)
+
+
+# ---------------------------------------------------------------------------
+# all_reduce (psum) / pmean
+# ---------------------------------------------------------------------------
+
+
+def _identity_meta(x: TensorProxy, axis, **kw):
+    return TensorProxy(shape=x.shape, dtype=x.dtype, device=x.device)
+
+
+def _all_reduce_impl(x, axis):
+    return lax.psum(x, _axes_tuple(axis))
+
+
+all_reduce = _make_coll("all_reduce", _identity_meta, _all_reduce_impl)
+
+
+@register_augmented_forward(all_reduce.id)
+def _all_reduce_aug(x, axis):
+    return VJPResult(all_reduce(x, axis), (axis,))
+
+
+@register_backward(all_reduce.id)
+def _all_reduce_bwd(axis, g):
+    # out_i = sum_j x_j ; replicated cotangent flows straight through
+    return g
+
+
+def _pmean_impl(x, axis, *, world_size=None):
+    return lax.pmean(x, _axes_tuple(axis))
+
+
+def _pmean_meta(x, axis, *, world_size):
+    return TensorProxy(shape=x.shape, dtype=x.dtype, device=x.device)
+
+
+pmean = _make_coll("pmean", _pmean_meta, _pmean_impl)
+
+
+@register_augmented_forward(pmean.id)
+def _pmean_aug(x, axis, *, world_size):
+    return VJPResult(pmean(x, axis, world_size=world_size), (world_size,))
+
+
+@register_backward(pmean.id)
+def _pmean_bwd(world_size, g):
+    # out = (1/N) sum_i x_i: each local input sees g/N
+    from ..ops import clang
+
+    return clang.true_divide(g, float(world_size))
+
+
+# ---------------------------------------------------------------------------
+# synchronize — DDP parameter marker (reference prims.py:376: fwd identity,
+# bwd all-reduce of the gradient)
+# ---------------------------------------------------------------------------
+
+
+def _sync_impl(x, axis):
+    return x
+
+
+synchronize = _make_coll("synchronize", _identity_meta, _sync_impl)
+
+
+@register_augmented_forward(synchronize.id)
+def _sync_aug(x, axis):
+    return VJPResult(synchronize(x, axis), (axis,))
+
+
+@register_backward(synchronize.id)
+def _sync_bwd(axis, g):
+    return all_reduce(g, axis)
+
+
+# tensor-parallel boundary syncs (reference prims.py:423-551)
+synchronize_tensor_parallel_input = _make_coll(
+    "synchronize_tensor_parallel_input", _identity_meta, _sync_impl
+)
+
+
+@register_augmented_forward(synchronize_tensor_parallel_input.id)
+def _tp_in_aug(x, axis):
+    return VJPResult(synchronize_tensor_parallel_input(x, axis), (axis,))
+
+
+@register_backward(synchronize_tensor_parallel_input.id)
+def _tp_in_bwd(axis, g):
+    return all_reduce(g, axis)
+
+
+synchronize_tensor_parallel_output = _make_coll(
+    "synchronize_tensor_parallel_output", _identity_meta, _all_reduce_impl
+)
+
+
+@register_augmented_forward(synchronize_tensor_parallel_output.id)
+def _tp_out_aug(x, axis):
+    return VJPResult(synchronize_tensor_parallel_output(x, axis), (axis,))
+
+
+@register_backward(synchronize_tensor_parallel_output.id)
+def _tp_out_bwd(axis, g):
+    return g
+
+
+# ---------------------------------------------------------------------------
+# axis_index — the device's coordinate along a mesh axis (traced scalar)
+# ---------------------------------------------------------------------------
+
+
+def _axis_index_meta(axis):
+    return TensorProxy(shape=(), dtype=dtypes.int32)
+
+
+def _axis_index_impl(axis):
+    return lax.axis_index(axis)
+
+
+axis_index = _make_coll("axis_index", _axis_index_meta, _axis_index_impl)
+
+
+# ---------------------------------------------------------------------------
+# ppermute / all_to_all — sequence & expert parallelism building blocks
+# ---------------------------------------------------------------------------
+
+
+def _ppermute_meta(x: TensorProxy, axis, perm):
+    return TensorProxy(shape=x.shape, dtype=x.dtype, device=x.device)
+
+
+def _ppermute_impl(x, axis, perm):
+    return lax.ppermute(x, _axes_tuple(axis)[0], perm)
+
+
+ppermute = _make_coll("ppermute", _ppermute_meta, _ppermute_impl)
+
+
+@register_augmented_forward(ppermute.id)
+def _ppermute_aug(x, axis, perm):
+    return VJPResult(ppermute(x, axis, perm), (axis, tuple(perm)))
+
+
+@register_backward(ppermute.id)
+def _ppermute_bwd(axis, perm, g):
+    inv = tuple((dst, src) for (src, dst) in perm)
+    return ppermute(g, axis, inv)
+
+
+def _all_to_all_meta(x: TensorProxy, axis, split_axis: int, concat_axis: int, *, world_size: int):
+    shape = list(x.shape)
+    shape[split_axis] //= world_size
+    shape[concat_axis] *= world_size
+    return TensorProxy(shape=tuple(shape), dtype=x.dtype, device=x.device)
+
+
+def _all_to_all_impl(x, axis, split_axis, concat_axis, *, world_size):
+    return lax.all_to_all(x, _axes_tuple(axis)[0], split_axis, concat_axis, tiled=True)
+
+
+all_to_all = _make_coll("all_to_all", _all_to_all_meta, _all_to_all_impl)
+
+
+@register_augmented_forward(all_to_all.id)
+def _all_to_all_aug(x, axis, split_axis, concat_axis, *, world_size):
+    return VJPResult(all_to_all(x, axis, split_axis, concat_axis, world_size=world_size),
+                     (axis, split_axis, concat_axis, world_size))
+
+
+@register_backward(all_to_all.id)
+def _all_to_all_bwd(axis, split_axis, concat_axis, world_size, g):
+    return all_to_all(g, axis, concat_axis, split_axis, world_size=world_size)
+
+
+# ---------------------------------------------------------------------------
+# broadcast / wait (API parity; wait is identity — XLA schedules overlap)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_impl(x, axis, root=0):
+    # everyone takes root's value
+    return lax.all_gather(x, _axes_tuple(axis)[0])[root]
+
+
+broadcast = _make_coll("broadcast", lambda x, axis, root=0: _identity_meta(x, axis), _broadcast_impl)
+
+
+def _wait_meta(x):
+    return TensorProxy(shape=x.shape, dtype=x.dtype, device=x.device)
+
+
+def _wait_impl(x):
+    return x
+
+
+wait = _make_coll("wait", _wait_meta, _wait_impl)
+
+
+@register_augmented_forward(wait.id)
+def _wait_aug(x):
+    return VJPResult(wait(x), ())
+
+
+@register_backward(wait.id)
+def _wait_bwd(g):
+    return g

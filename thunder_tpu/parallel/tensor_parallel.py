@@ -1,0 +1,131 @@
+"""Tensor parallelism: Megatron-style column/row parallel layers.
+
+Re-design of reference thunder/distributed/tensor_parallel/ (column_wise.py:154,
+row_wise.py:159): the reference rewrites computation traces with a visitor
+inserting synchronize_tensor_parallel_{input,output} prims. Here the rewrite
+happens at module level — target Linear/Embedding modules are replaced with
+parallel variants whose forwards record those same sync prims — which under
+the per-device shard_map execution model yields the identical trace: local
+matmuls + boundary collectives lowered to psum over the `tp` mesh axis.
+
+  column: weight (out, in) sharded on out; input sync'd (bwd all-reduce);
+          output stays column-sharded.
+  row:    weight (out, in) sharded on in; consumes column-sharded input;
+          output all-reduced (fwd psum / bwd identity); bias added after.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from jax.sharding import Mesh
+
+from .. import nn
+from ..nn.module import Module, ThunderModule
+from ..ops import ltorch
+from . import prims as dist_prims
+from .mesh import TP_AXIS, axis_size
+from .transforms import DistPlan, ParamStrategy, _get_plan, _place_params, _set_plan
+
+
+class ColumnParallelLinear(Module):
+    def __init__(self, orig: nn.Linear, axis: str, tp_size: int):
+        super().__init__()
+        assert orig.out_features % tp_size == 0, \
+            f"column-parallel out_features {orig.out_features} % tp={tp_size}"
+        self.weight = orig.weight
+        self.bias = orig.bias if getattr(orig, "bias", None) is not None else None
+        self.axis = axis
+
+    def forward(self, x):
+        x = dist_prims.synchronize_tensor_parallel_input(x, self.axis)
+        return ltorch.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Module):
+    def __init__(self, orig: nn.Linear, axis: str, tp_size: int):
+        super().__init__()
+        assert orig.in_features % tp_size == 0, \
+            f"row-parallel in_features {orig.in_features} % tp={tp_size}"
+        self.weight = orig.weight
+        self.bias = orig.bias if getattr(orig, "bias", None) is not None else None
+        self.axis = axis
+
+    def forward(self, x):
+        y = ltorch.linear(x, self.weight, None)
+        y = dist_prims.synchronize_tensor_parallel_output(y, self.axis)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Module):
+    """Embedding sharded on the embedding (feature) dim — output column-sharded."""
+
+    def __init__(self, orig: nn.Embedding, axis: str, tp_size: int):
+        super().__init__()
+        assert orig.embedding_dim % tp_size == 0
+        self.weight = orig.weight
+        self.axis = axis
+
+    def forward(self, idx):
+        return ltorch.embedding(idx, self.weight)
+
+
+def _replace_module(root: Module, qualname: str, new: Module) -> Module:
+    parts = qualname.split(".")
+    mod = root
+    for p in parts[:-1]:
+        mod = mod._modules[p]
+    old = mod._modules[parts[-1]]
+    mod._modules[parts[-1]] = new
+    return old
+
+
+def _param_names_of(root: Module, qualname: str) -> list[str]:
+    mod = root
+    for p in qualname.split("."):
+        mod = mod._modules[p]
+    return [f"{qualname}.{n}" for n in mod._parameters if mod._parameters[n] is not None]
+
+
+def column_parallel(tmodule: ThunderModule, mesh: Mesh, target_modules: Sequence[str],
+                    *, axis: str = TP_AXIS) -> ThunderModule:
+    """Reference thunder/distributed/tensor_parallel/column_wise.py:154."""
+    return _tp_apply(tmodule, mesh, target_modules, axis, "column")
+
+
+def row_parallel(tmodule: ThunderModule, mesh: Mesh, target_modules: Sequence[str],
+                 *, axis: str = TP_AXIS) -> ThunderModule:
+    """Reference thunder/distributed/tensor_parallel/row_wise.py:159."""
+    return _tp_apply(tmodule, mesh, target_modules, axis, "row")
+
+
+def _tp_apply(tmodule: ThunderModule, mesh: Mesh, targets: Sequence[str], axis: str, kind: str) -> ThunderModule:
+    n = axis_size(mesh, axis)
+    root = tmodule.module
+    plan = _get_plan(tmodule) or DistPlan(mesh)
+    new_plan = DistPlan(mesh, {}, (), axis)
+    for qual in targets:
+        mod = root
+        for p in qual.split("."):
+            mod = mod._modules[p]
+        if isinstance(mod, (ColumnParallelLinear, RowParallelLinear)):
+            raise ValueError(f"{qual} already tensor-parallel")
+        if isinstance(mod, nn.Linear):
+            new = ColumnParallelLinear(mod, axis, n) if kind == "column" else RowParallelLinear(mod, axis, n)
+        elif isinstance(mod, nn.Embedding) and kind == "column":
+            new = VocabParallelEmbedding(mod, axis, n)
+        else:
+            raise TypeError(f"cannot {kind}-parallelize {type(mod).__name__} at {qual}")
+        _replace_module(root, qual, new)
+        if kind == "column":
+            new_plan.param_strategies[f"{qual}.weight"] = [ParamStrategy("column", axis)]
+            if getattr(new, "bias", None) is not None:
+                new_plan.param_strategies[f"{qual}.bias"] = [ParamStrategy("column", axis)]
+        else:
+            new_plan.param_strategies[f"{qual}.weight"] = [ParamStrategy("row", axis)]
+            # row bias replicated: no strategy entry -> P() default
+    plan = plan.merge(new_plan)
+    _set_plan(tmodule, plan)
+    _place_params(tmodule, plan)
+    return tmodule

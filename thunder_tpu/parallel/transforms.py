@@ -1,0 +1,188 @@
+"""Distributed strategy transforms: DDP, FSDP (ZeRO-3), hybrid meshes.
+
+Re-design of reference thunder/distributed/__init__.py:203 (ddp), :382 (fsdp)
+and the DDPTransform/FSDPTransform trace transforms
+(thunder/distributed/transforms/{ddp_v2,fsdp_v2}.py). The execution model is
+per-device: the training step runs inside ``shard_map`` over the mesh, all
+traced shapes are device-local, and parameter (un)sharding is explicit
+collective prims recorded in the trace:
+
+  DDP:   params replicated; `synchronize` marker (fwd identity / bwd
+         all-reduce) inserted per param — the reference's grad-allreduce.
+  FSDP:  params dim-0 sharded; `all_gather` before use (fwd) and
+         reduce-scatter of grads (VJP of all_gather) — ZeRO-3 semantics.
+  Mixed: 2-D meshes stack both (reference thunder/plugins/distributed.py:118).
+
+XLA's latency-hiding scheduler overlaps these collectives with compute (the
+role of NCCL side-streams + sort_waits in the reference)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.proxies import DistParallelType
+from ..core.transform_common import Transform
+from ..nn.module import Parameter, ThunderModule
+from . import prims as dist_prims
+from .mesh import DP_AXIS, FSDP_AXIS, TP_AXIS, axis_size
+
+
+@dataclass
+class ParamStrategy:
+    kind: str  # 'replicate' | 'shard0' | 'column' | 'row'
+    axis: str
+
+    @property
+    def dist_type(self) -> DistParallelType:
+        return {
+            "replicate": DistParallelType.REPLICATED,
+            "shard0": DistParallelType.FULLY_SHARDED,
+            "column": DistParallelType.COLUMN_WISE,
+            "row": DistParallelType.ROW_WISE,
+        }[self.kind]
+
+
+@dataclass
+class DistPlan:
+    mesh: Mesh
+    # per-param chain of strategies, applied in order at trace time
+    param_strategies: dict = field(default_factory=dict)
+    data_axes: tuple = ()  # axes the batch dim (dim 0) is sharded over
+    tp_axis: Optional[str] = None
+    seq_axes: tuple = ()  # axes the sequence dim (dim 1) is sharded over (context parallel)
+
+    def world_size(self, axis: str) -> int:
+        return axis_size(self.mesh, axis)
+
+    @property
+    def loss_axes(self) -> tuple:
+        return tuple(self.data_axes) + tuple(a for a in self.seq_axes if a not in self.data_axes)
+
+    @property
+    def loss_world_size(self) -> int:
+        n = 1
+        for a in self.loss_axes:
+            n *= self.world_size(a)
+        return n
+
+    def param_spec(self, name: str, ndim: int) -> P:
+        parts = [None] * max(1, ndim)
+        for st in self.param_strategies.get(name, ()):
+            if st.kind == "shard0":
+                parts[0] = st.axis
+            elif st.kind == "column":
+                parts[0] = st.axis  # weight (out, in): column-parallel shards out
+            elif st.kind == "row":
+                if ndim >= 2:
+                    parts[1] = st.axis  # weight (out, in): row-parallel shards in
+                else:
+                    parts[0] = st.axis
+        return P(*parts[:ndim]) if ndim > 0 else P()
+
+    def merge(self, other: "DistPlan") -> "DistPlan":
+        merged = DistPlan(self.mesh, dict(self.param_strategies), tuple(self.data_axes),
+                          self.tp_axis or other.tp_axis, tuple(self.seq_axes))
+        for k, v in other.param_strategies.items():
+            merged.param_strategies.setdefault(k, [])
+            merged.param_strategies[k] = list(merged.param_strategies[k]) + list(v)
+        for a in other.data_axes:
+            if a not in merged.data_axes:
+                merged.data_axes = merged.data_axes + (a,)
+        for a in getattr(other, "seq_axes", ()):
+            if a not in merged.seq_axes:
+                merged.seq_axes = merged.seq_axes + (a,)
+        return merged
+
+
+class DistributedTransform(Transform):
+    def __init__(self, plan: DistPlan):
+        self.plan = plan
+
+
+class DDPTransform(DistributedTransform):
+    """Reference thunder/distributed/transforms/ddp_v2.py:25."""
+
+
+class FSDPTransform(DistributedTransform):
+    """Reference thunder/distributed/transforms/fsdp_v2.py:87."""
+
+
+def _get_plan(tmodule: ThunderModule) -> Optional[DistPlan]:
+    return getattr(tmodule, "_dist_plan", None)
+
+
+def _set_plan(tmodule: ThunderModule, plan: DistPlan) -> None:
+    tmodule._dist_plan = plan
+
+
+def _place_params(tmodule: ThunderModule, plan: DistPlan) -> None:
+    """Physically shard parameter storage per plan (reference _shard_params,
+    thunder/distributed/__init__.py:462)."""
+    for name, p in tmodule.get_parameters().items():
+        spec = plan.param_spec(name, p.data.ndim)
+        try:
+            p.data = jax.device_put(p.data, NamedSharding(plan.mesh, spec))
+        except Exception:
+            pass  # single-device fallback: leave placement to jit
+
+
+def ddp(tmodule: ThunderModule, mesh: Mesh, *, axis: str = DP_AXIS) -> ThunderModule:
+    """Replicated data parallel (reference thunder.distributed.ddp,
+    thunder/distributed/__init__.py:203): params replicated over `axis`,
+    batch sharded, grads all-reduced (pre-averaged via the loss pmean)."""
+    plan = _get_plan(tmodule) or DistPlan(mesh)
+    new = DistPlan(mesh, {}, (axis,))
+    for name, p in tmodule.get_parameters().items():
+        new.param_strategies[name] = [ParamStrategy("replicate", axis)]
+    plan = plan.merge(new)
+    _set_plan(tmodule, plan)
+    _place_params(tmodule, plan)
+    tmodule._cfn._transforms.append(DDPTransform(plan))
+    return tmodule
+
+
+def fsdp(
+    tmodule: ThunderModule,
+    mesh: Mesh,
+    *,
+    axis: str = FSDP_AXIS,
+    min_shard_numel: int = 1024,
+) -> ThunderModule:
+    """ZeRO-3 sharded data parallel (reference thunder.distributed.fsdp,
+    thunder/distributed/__init__.py:382): each param dim-0 sharded over
+    `axis`; all-gather before use, grads reduce-scattered; small or
+    indivisible params stay replicated (the reference pads instead,
+    __init__.py:508 — divisibility-or-replicate keeps XLA shapes static)."""
+    plan = _get_plan(tmodule) or DistPlan(mesh)
+    n = axis_size(mesh, axis)
+    new = DistPlan(mesh, {}, (axis,))
+    for name, p in tmodule.get_parameters().items():
+        shape = tuple(p.data.shape)
+        if len(shape) >= 1 and shape[0] % n == 0 and p.data.size >= min_shard_numel:
+            new.param_strategies[name] = [ParamStrategy("shard0", axis)]
+        else:
+            new.param_strategies[name] = [ParamStrategy("replicate", axis)]
+    plan = plan.merge(new)
+    _set_plan(tmodule, plan)
+    _place_params(tmodule, plan)
+    tmodule._cfn._transforms.append(FSDPTransform(plan))
+    return tmodule
+
+
+def apply_param_collectives(params: dict, plan: DistPlan) -> dict:
+    """Trace-time: turn device-local param proxies into full params via the
+    plan's collective chain (the analog of the reference's `synchronize`
+    insertion at param-use sites, fsdp_v2.py:87)."""
+    full = {}
+    for k, v in params.items():
+        for st in plan.param_strategies.get(k, ()):
+            if st.kind == "shard0":
+                v = dist_prims.all_gather(v, st.axis, world_size=plan.world_size(st.axis))
+            elif st.kind == "replicate":
+                v = dist_prims.synchronize(v, st.axis)
+            # column/row params stay local: TP layers consume local shards
+        full[k] = v
+    return full

@@ -42,11 +42,41 @@ class TrainStep:
     def _build(self, batch_args, batch_kwargs):
         from .transforms.autodiff import ThunderValueAndGrad
 
+        plan = getattr(self.tmodule, "_dist_plan", None)
+        inner = self.tmodule._cfn._cd.fn
+        optimizer = self.optimizer
+
+        if plan is None:
+            traced = inner
+        else:
+            from .ops import ltorch
+            from .parallel import prims as dist_prims
+            from .parallel.transforms import apply_param_collectives
+
+            def traced(params: dict, args: tuple, kwargs: dict):
+                import contextlib
+
+                from .parallel.context_parallel import seq_parallel_tracing
+
+                seq_axes = tuple(getattr(plan, "seq_axes", ()))
+                cp_ctx = (
+                    seq_parallel_tracing(seq_axes[0], plan.world_size(seq_axes[0]))
+                    if seq_axes else contextlib.nullcontext()
+                )
+                full_params = apply_param_collectives(params, plan)
+                with cp_ctx:
+                    local_loss = inner(full_params, args, kwargs)
+                if plan.loss_axes:
+                    s = dist_prims.all_reduce(local_loss, plan.loss_axes)
+                    return ltorch.div(s, float(plan.loss_world_size))
+                return local_loss
+
+            traced.__name__ = f"dist_{getattr(inner, '__name__', 'step')}"
+
         # argnums=0: the params dict is arg 0 of the traced wrapper; inside the
         # jitted step params are raw arrays, so positional marking is required
-        vag = ThunderValueAndGrad(self.tmodule._cfn._cd.fn, argnums=0)
+        vag = ThunderValueAndGrad(traced, argnums=0, transforms=self.tmodule._cfn._transforms)
         self._vag = vag
-        optimizer = self.optimizer
 
         def raw_step(param_arrays: dict, opt_state, args, kwargs):
             loss, grads = vag(param_arrays, args, kwargs)
@@ -55,7 +85,11 @@ class TrainStep:
             return loss, new_params, new_state
 
         donate = (0, 1) if self.donate else ()
-        self._jitted = jax.jit(raw_step, donate_argnums=donate)
+        if plan is None:
+            self._jitted = jax.jit(raw_step, donate_argnums=donate)
+        else:
+            self._jitted = _shard_mapped_step(raw_step, plan, self.tmodule, self.opt_state,
+                                              batch_args, batch_kwargs, donate)
 
     def __call__(self, *args, **kwargs):
         params = self.tmodule.get_parameters()
@@ -73,3 +107,59 @@ class TrainStep:
     @property
     def compile_stats(self):
         return getattr(self, "_vag", None) and self._vag._cs
+
+
+def _batch_pspec(plan, leaf):
+    from jax.sharding import PartitionSpec as P
+
+    ndim = getattr(leaf, "ndim", 0)
+    seq_axes = tuple(getattr(plan, "seq_axes", ()))
+    if ndim == 0 or (not plan.data_axes and not seq_axes):
+        return P()
+    first = None
+    if plan.data_axes:
+        first = plan.data_axes[0] if len(plan.data_axes) == 1 else tuple(plan.data_axes)
+    parts = [first]
+    if seq_axes and ndim >= 2:
+        parts.append(seq_axes[0] if len(seq_axes) == 1 else tuple(seq_axes))
+    while len(parts) < ndim:
+        parts.append(None)
+    return P(*parts)
+
+
+def _opt_state_specs(opt_state, param_specs: dict):
+    from jax.sharding import PartitionSpec as P
+
+    def rec(node):
+        if isinstance(node, dict):
+            if set(node.keys()) == set(param_specs.keys()):
+                return dict(param_specs)
+            return {k: rec(v) for k, v in node.items()}
+        return P()
+
+    return rec(opt_state)
+
+
+def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwargs, donate):
+    """Wrap the step in shard_map over the plan's mesh: params/opt-state use
+    per-param specs, batch leaves shard dim 0 over the data axes, loss comes
+    back replicated. XLA lowers the recorded collective prims to ICI
+    collectives and overlaps them with compute."""
+    from jax.sharding import PartitionSpec as P
+
+    params = {k: p.data for k, p in tmodule.get_parameters().items()}
+    param_specs = {k: plan.param_spec(k, v.ndim) for k, v in params.items()}
+    if opt_state is None:
+        raise RuntimeError("opt_state must be initialized before building the distributed step")
+    opt_specs = _opt_state_specs(opt_state, param_specs)
+    args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_args)
+    kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_kwargs)
+    in_specs = (param_specs, opt_specs, args_specs, kwargs_specs)
+    out_specs = (P(), param_specs, opt_specs)
+    try:
+        smapped = jax.shard_map(raw_step, mesh=plan.mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax: check_rep
+        smapped = jax.shard_map(raw_step, mesh=plan.mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_rep=False)
+    return jax.jit(smapped, donate_argnums=donate)

@@ -1,0 +1,7 @@
+from ..core.transform_common import Transform
+from .autocast import AutocastTransform, autocast
+from .constant_folding import ConstantFolding, fold_constants
+from .materialization import MaterializationTransform, MetaArray, meta_device
+from .prune_prologue_checks import PrunePrologueChecks
+from .quantization import QuantizeInt8Transform, quantize_int8
+from .remat import RematTransform, checkpoint

@@ -1,0 +1,62 @@
+"""Autocast: mixed-precision policy as a trace transform.
+
+Re-design of reference thunder/transforms/autocast.py (310 LoC): per-op dtype
+rules — matmul-class ops run in the low-precision compute dtype (bf16 on TPU:
+the MXU's native input format), normalizations/reductions stay f32. Applied by
+re-interpreting the computation trace with casts inserted at op boundaries."""
+from __future__ import annotations
+
+from ..core import dtypes, prims
+from ..core.prims import PrimIDs
+from ..core.proxies import TensorProxy
+from ..core.trace_interpreter import TraceSubstitutionProcessor
+from ..core.transform_common import Transform
+
+# ops computed in the autocast dtype (inputs cast down)
+_LOW_PRECISION_IDS = {
+    PrimIDs.MATMUL,
+    PrimIDs.LINEAR,
+    PrimIDs.CONVOLUTION,
+    PrimIDs.GROUPED_MM,
+    "torch.nn.functional.scaled_dot_product_attention",
+}
+# composite ops forced to f32 compute (their decompositions stay f32)
+_F32_IDS = {
+    "torch.nn.functional.layer_norm",
+    "torch.nn.functional.rms_norm",
+    "torch.softmax",
+    "torch.log_softmax",
+    "torch.nn.functional.cross_entropy",
+}
+
+
+class AutocastTransform(Transform):
+    def __init__(self, dtype: dtypes.dtype = dtypes.bfloat16):
+        self.dtype = dtypes.to_dtype(dtype)
+
+    def _cast(self, x, to):
+        if isinstance(x, TensorProxy) and x.dtype.is_float and x.dtype != to:
+            return prims.convert_element_type(x, to)
+        return x
+
+    def transform_traces_pre_autodiff(self, prologue_trc, computation_trc, *, compile_data=None):
+        to = self.dtype
+
+        def visitor(bsym, args, kwargs):
+            if bsym.sym.id in _LOW_PRECISION_IDS:
+                args = tuple(self._cast(a, to) for a in args)
+                kwargs = {k: self._cast(v, to) for k, v in kwargs.items()}
+                return bsym.sym(*args, **kwargs)
+            if bsym.sym.id in _F32_IDS:
+                args = tuple(self._cast(a, dtypes.float32) for a in args)
+                out = bsym.sym(*args, **kwargs)
+                return out
+            return None
+
+        new_trc = TraceSubstitutionProcessor(computation_trc, visitor)()
+        new_trc.set_provenance(f"Autocast to {to.name}")
+        return prologue_trc, new_trc
+
+
+def autocast(dtype=dtypes.bfloat16) -> AutocastTransform:
+    return AutocastTransform(dtype)

@@ -325,6 +325,158 @@ def _cvt_bwd(in_dtype, g):
 
 register_grad(PrimIDs.STOP_GRADIENT, lambda a: VJPResult(prims.stop_gradient(a), ()), lambda g: None)
 
+# piecewise-constant ops: zero gradient almost everywhere
+for _pid, _prim in ((PrimIDs.FLOOR, prims.floor), (PrimIDs.CEIL, prims.ceil),
+                    (PrimIDs.ROUND, prims.round), (PrimIDs.TRUNC, prims.trunc),
+                    (PrimIDs.SIGN, prims.sign)):
+    def _const_aug(a, _p=_prim):
+        return VJPResult(_p(a), ())
+
+    register_grad(_pid, _const_aug, lambda g: _zeros_like(g))
+
+
+@register_augmented_forward(PrimIDs.EXP2)
+def _exp2_aug(a):
+    out = prims.exp2(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.EXP2)
+def _exp2_bwd(out, g):
+    return prims.mul(g, prims.mul(out, clang.full_like(out, math.log(2.0))))
+
+
+@register_augmented_forward(PrimIDs.LOG2)
+def _log2_aug(a):
+    return VJPResult(prims.log2(a), (a,))
+
+
+@register_backward(PrimIDs.LOG2)
+def _log2_bwd(a, g):
+    return prims.div(g, prims.mul(a, clang.full_like(a, math.log(2.0))))
+
+
+@register_augmented_forward(PrimIDs.TAN)
+def _tan_aug(a):
+    out = prims.tan(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.TAN)
+def _tan_bwd(out, g):
+    return prims.mul(g, clang.add(prims.mul(out, out), 1.0))
+
+
+@register_augmented_forward(PrimIDs.SINH)
+def _sinh_aug(a):
+    return VJPResult(prims.sinh(a), (a,))
+
+
+@register_backward(PrimIDs.SINH)
+def _sinh_bwd(a, g):
+    return prims.mul(g, prims.cosh(a))
+
+
+@register_augmented_forward(PrimIDs.COSH)
+def _cosh_aug(a):
+    return VJPResult(prims.cosh(a), (a,))
+
+
+@register_backward(PrimIDs.COSH)
+def _cosh_bwd(a, g):
+    return prims.mul(g, prims.sinh(a))
+
+
+@register_augmented_forward(PrimIDs.ASIN)
+def _asin_aug(a):
+    return VJPResult(prims.asin(a), (a,))
+
+
+@register_backward(PrimIDs.ASIN)
+def _asin_bwd(a, g):
+    return prims.mul(g, prims.rsqrt(clang.sub(1.0, prims.mul(a, a))))
+
+
+@register_augmented_forward(PrimIDs.ACOS)
+def _acos_aug(a):
+    return VJPResult(prims.acos(a), (a,))
+
+
+@register_backward(PrimIDs.ACOS)
+def _acos_bwd(a, g):
+    return prims.neg(prims.mul(g, prims.rsqrt(clang.sub(1.0, prims.mul(a, a)))))
+
+
+@register_augmented_forward(PrimIDs.ATAN)
+def _atan_aug(a):
+    return VJPResult(prims.atan(a), (a,))
+
+
+@register_backward(PrimIDs.ATAN)
+def _atan_bwd(a, g):
+    return prims.div(g, clang.add(prims.mul(a, a), 1.0))
+
+
+@register_augmented_forward(PrimIDs.ASINH)
+def _asinh_aug(a):
+    return VJPResult(prims.asinh(a), (a,))
+
+
+@register_backward(PrimIDs.ASINH)
+def _asinh_bwd(a, g):
+    return prims.mul(g, prims.rsqrt(clang.add(prims.mul(a, a), 1.0)))
+
+
+@register_augmented_forward(PrimIDs.ACOSH)
+def _acosh_aug(a):
+    return VJPResult(prims.acosh(a), (a,))
+
+
+@register_backward(PrimIDs.ACOSH)
+def _acosh_bwd(a, g):
+    return prims.mul(g, prims.rsqrt(clang.sub(prims.mul(a, a), 1.0)))
+
+
+@register_augmented_forward(PrimIDs.ATANH)
+def _atanh_aug(a):
+    return VJPResult(prims.atanh(a), (a,))
+
+
+@register_backward(PrimIDs.ATANH)
+def _atanh_bwd(a, g):
+    return prims.div(g, clang.sub(1.0, prims.mul(a, a)))
+
+
+@register_augmented_forward(PrimIDs.ERFC)
+def _erfc_aug(a):
+    return VJPResult(prims.erfc(a), (a,))
+
+
+@register_backward(PrimIDs.ERFC)
+def _erfc_bwd(a, g):
+    c = -2.0 / math.sqrt(math.pi)
+    return prims.mul(g, prims.mul(clang.full_like(a, c), prims.exp(prims.neg(prims.mul(a, a)))))
+
+
+@register_augmented_forward(PrimIDs.FMOD)
+def _fmod_aug(a, b):
+    return VJPResult(prims.fmod(a, b), (a, b))
+
+
+@register_backward(PrimIDs.FMOD)
+def _fmod_bwd(a, b, g):
+    return g, prims.neg(prims.mul(g, prims.trunc(prims.div(a, b))))
+
+
+@register_augmented_forward(PrimIDs.REMAINDER)
+def _remainder_aug(a, b):
+    return VJPResult(prims.remainder(a, b), (a, b))
+
+
+@register_backward(PrimIDs.REMAINDER)
+def _remainder_bwd(a, b, g):
+    return g, prims.neg(prims.mul(g, prims.floor(prims.div(a, b))))
+
 
 # ---------------------------------------------------------------------------
 # shape-op rules
@@ -459,11 +611,13 @@ def _taa_bwd(in_shape, in_dtype, indices, dim, g):
 
 @register_augmented_forward(PrimIDs.EMBEDDING)
 def _embedding_aug(indices, weight):
+    indices = clang.ensure_proxy(indices)
     return VJPResult(prims.embedding(indices, weight), (indices, weight.shape, weight.dtype))
 
 
 @register_backward(PrimIDs.EMBEDDING)
 def _embedding_bwd(indices, w_shape, w_dtype, g):
+    indices = clang.ensure_proxy(indices)
     zeros = prims.full(w_shape, 0.0, dtype=w_dtype)
     flat_idx = prims.reshape(indices, (indices.numel,)) if indices.ndim != 1 else indices
     flat_g = prims.reshape(g, (indices.numel, w_shape[1]))
@@ -677,13 +831,14 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
         if needs_grad and out_is_diff and has_grad_rule(bsym.sym.id):
             rule = augmented_forward_impls[bsym.sym.id]
             res = rule(*margs, **mkwargs)
-            map_out(bsym.output, res.out)
-            new_outs = _flat_tensors(res.out)
-            tape.append(TapeEntry(bsym.sym.id, in_tensors, new_outs, tuple(res.residuals), None))
-            for o in new_outs:
-                if _is_diff_dtype(o):
-                    diff.add(o.name)
-            return
+            if res is not NotImplemented:  # rules may decline (e.g. kernel shape checkers)
+                map_out(bsym.output, res.out)
+                new_outs = _flat_tensors(res.out)
+                tape.append(TapeEntry(bsym.sym.id, in_tensors, new_outs, tuple(res.residuals), None))
+                for o in new_outs:
+                    if _is_diff_dtype(o):
+                        diff.add(o.name)
+                return
         if needs_grad and out_is_diff and bsym.sym.id in JAX_VJP_FALLBACK:
             _process_fallback(bsym, margs, mkwargs, in_tensors)
             return
@@ -898,9 +1053,10 @@ class ThunderValueAndGrad:
     with the ThunderFunction autograd bridge (torch_autograd.py:17) — TPU-
     native there is no runtime autograd tape, so the API is functional."""
 
-    def __init__(self, fn: Callable, argnums=None):
+    def __init__(self, fn: Callable, argnums=None, transforms: Sequence = ()):
         self.fn = fn
         self.argnums = (argnums,) if isinstance(argnums, int) else (tuple(argnums) if argnums is not None else None)
+        self.transforms = list(transforms)
         self._cache: dict = {}
         self._cs = None  # CompileStats of last compile
 
@@ -936,18 +1092,24 @@ class ThunderValueAndGrad:
         cs.last_trace_tracing_time_ns = _time.perf_counter_ns() - t0
 
         t1 = _time.perf_counter_ns()
+        for tf in self.transforms:
+            _, trc = tf.transform_traces_pre_autodiff(None, trc, compile_data=None)
         trc = _dce(trc)
         fb = forward_and_backward_traces(trc)
-        fwd_claimed = transform_for_execution(fb.forward_trace, resolve_executors(None))
-        bwd_claimed = transform_for_execution(fb.backward_trace, resolve_executors(None))
+        fwd_trc, bwd_trc = fb.forward_trace, fb.backward_trace
+        for tf in self.transforms:
+            fwd_trc = tf.transform_trace_post_optimization(fwd_trc, compile_data=None)
+            bwd_trc = tf.transform_trace_post_optimization(bwd_trc, compile_data=None)
+        fwd_claimed = transform_for_execution(fwd_trc, resolve_executors(None))
+        bwd_claimed = transform_for_execution(bwd_trc, resolve_executors(None))
         cs.last_trace_transform_time_ns = _time.perf_counter_ns() - t1
 
         t2 = _time.perf_counter_ns()
         fwd_fn = fwd_claimed.python_callable()
         bwd_fn = bwd_claimed.python_callable()
         cs.last_compile_time_ns = _time.perf_counter_ns() - t2
-        cs.last_traces = [trc, fb.forward_trace, fwd_claimed]
-        cs.last_backward_traces = [fb.backward_trace, bwd_claimed]
+        cs.last_traces = [trc, fwd_trc, fwd_claimed]
+        cs.last_backward_traces = [bwd_trc, bwd_claimed]
 
         arg_name_to_pos = {p.name: i for i, p in enumerate(trc.args)}
         grad_positions = tuple(arg_name_to_pos[n] for n in fb.grad_arg_names)
@@ -956,6 +1118,7 @@ class ThunderValueAndGrad:
         return entry
 
     def __call__(self, *args, **kwargs):
+        import jax
         import jax.numpy as jnp
 
         from .. import _cache_key, _is_tensor_like, _unwrap
@@ -964,6 +1127,17 @@ class ThunderValueAndGrad:
         leaves, treedef = tree_flatten((args, kwargs))
         tensor_mask = [_is_tensor_like(l) for l in leaves]
         key = _cache_key(leaves, tensor_mask)
+        # Under an ambient jax trace (TrainStep's jit/shard_map), compiled
+        # entries bake that trace's tracers as constants — they must not
+        # outlive it. Key such entries by the tracer's trace identity so a
+        # retrace recompiles instead of resurrecting stale tracers (a strong
+        # ref to the trace object pins its id against reuse).
+        tracer_leaves = [l for l in leaves if isinstance(l, jax.core.Tracer)]
+        if tracer_leaves:
+            trace_obj = getattr(tracer_leaves[0], "_trace", None)
+            key = key + (("ambient_trace", id(trace_obj)),)
+            self._trace_refs = getattr(self, "_trace_refs", {})
+            self._trace_refs[key] = trace_obj
         entry = self._cache.get(key)
         if entry is None:
             entry = self._compile(args, kwargs, key)

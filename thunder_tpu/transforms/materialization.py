@@ -1,0 +1,89 @@
+"""Deferred (meta-device) initialization + materialization.
+
+Re-design of reference thunder/transforms/materialization.py:92: modules built
+on the META device carry only shapes; the transform materializes real arrays
+(optionally directly sharded onto a mesh) right before first use — how 70B
+params get created without host OOM."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.transform_common import Transform
+from ..nn.module import Module, Parameter
+
+
+class MetaArray:
+    """Shape/dtype-only stand-in for a parameter's data."""
+
+    __slots__ = ("shape", "dtype", "init_fn")
+
+    def __init__(self, shape, dtype, init_fn: Optional[Callable] = None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.init_fn = init_fn
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+_meta_mode = [False]
+
+
+@contextmanager
+def meta_device():
+    """Build modules without allocating arrays: nn layers check this flag via
+    jax.eval_shape-style MetaArray creation (layers constructed inside create
+    MetaArrays if their RNG init raises under the disabled backend).
+
+    Usage:
+        with meta_device():
+            model = GPT(big_config)   # instant, no memory
+        MaterializationTransform(seed=0).transform_module(tt.jit(model))
+    """
+    _meta_mode[0] = True
+    try:
+        yield
+    finally:
+        _meta_mode[0] = False
+
+
+def is_meta_mode() -> bool:
+    return _meta_mode[0]
+
+
+class MaterializationTransform(Transform):
+    def __init__(self, seed: int = 0, sharding_fn: Optional[Callable] = None):
+        self.seed = seed
+        self.sharding_fn = sharding_fn  # name, shape -> NamedSharding | None
+
+    def transform_module(self, tmodule) -> None:
+        root = tmodule.module if hasattr(tmodule, "module") else tmodule
+        key = jax.random.PRNGKey(self.seed)
+        i = 0
+        for name, p in root.named_parameters():
+            if not isinstance(p.data, MetaArray):
+                continue
+            meta = p.data
+            sub = jax.random.fold_in(key, i)
+            i += 1
+            if meta.init_fn is not None:
+                arr = meta.init_fn(sub)
+            else:
+                arr = jax.random.normal(sub, meta.shape, meta.dtype) * 0.02
+            if self.sharding_fn is not None:
+                sh = self.sharding_fn(name, meta.shape)
+                if sh is not None:
+                    arr = jax.device_put(arr, sh)
+            p.data = arr

@@ -1,0 +1,104 @@
+"""Weight quantization: int8 per-channel weight-only quantized linears.
+
+Re-design of reference thunder/transforms/quantization.py:47
+(BitsAndBytesLinearQuant4bit: swap params for quantized tensors + rewrite
+linears to a dequant-matmul executor op). TPU-native: NF4/bnb is a CUDA
+library, so the quantized format here is symmetric per-output-channel int8
+(VPU-friendly dequant fused into the matmul's epilogue by XLA; an int4/Pallas
+quantized-matmul kernel is the upgrade path)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.proxies import TensorProxy
+from ..core.symbol import OpTags, Symbol
+from ..core.transform_common import Transform
+from ..executors.jaxex import ex as jax_ex
+from ..nn.module import Parameter
+from ..ops import clang
+from .autodiff import VJPResult, register_augmented_forward, register_backward
+
+
+def quantize_int8(w) -> tuple:
+    """w (out, in) -> (int8 weights, f32 per-row scales)."""
+    amax = jnp.max(jnp.abs(w), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def _quantized_linear_meta(x, qweight, scale, bias=None):
+    return TensorProxy(shape=x.shape[:-1] + (qweight.shape[0],), dtype=x.dtype, device=x.device)
+
+
+def _quantized_linear_impl(x, qweight, scale, bias=None):
+    w = qweight.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)[:, None]
+    out = jnp.matmul(x, w.T.astype(x.dtype), preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+quantized_linear = Symbol(
+    "quantized_linear", _quantized_linear_meta, id="quant.linear_int8", is_prim=True, module="quant",
+    tags=(OpTags.MATMUL_OP,),
+)
+jax_ex.register_implementation(quantized_linear.id, _quantized_linear_impl)
+
+
+@register_augmented_forward(quantized_linear.id)
+def _qlin_aug(x, qweight, scale, bias=None):
+    return VJPResult(quantized_linear(x, qweight, scale, bias), (qweight, scale))
+
+
+@register_backward(quantized_linear.id)
+def _qlin_bwd(qweight, scale, g):
+    # weight frozen: only dx (dequantized matmul)
+    from ..core import prims
+
+    wq = prims.convert_element_type(qweight, dtypes.bfloat16)
+    w = prims.mul(wq, clang.expand_to(clang.unsqueeze(prims.convert_element_type(scale, dtypes.bfloat16), 1), wq.shape))
+    gx = prims.matmul(prims.convert_element_type(g, dtypes.bfloat16), w)
+    return prims.convert_element_type(gx, g.dtype), None, None, None
+
+
+class QuantizedLinear:
+    """Module stand-in recorded by QuantizeInt8Transform."""
+
+    def __init__(self, qweight, scale, bias):
+        self.qweight = qweight
+        self.scale = scale
+        self.bias = bias
+
+
+class QuantizeInt8Transform(Transform):
+    """Swap nn.Linear weights for int8 + rewrite forwards (transform_module
+    hook, mirroring the reference's param-override approach,
+    thunder/core/module.py:30 + quantization.py:47)."""
+
+    def __init__(self, target_predicate=None):
+        self.target_predicate = target_predicate or (lambda name, mod: True)
+
+    def transform_module(self, tmodule) -> None:
+        from .. import nn as _nn
+
+        root = tmodule.module if hasattr(tmodule, "module") else tmodule
+        for name, mod in list(root.named_modules()):
+            if not isinstance(mod, _nn.Linear) or not self.target_predicate(name, mod):
+                continue
+            q, s = quantize_int8(jnp.asarray(mod.weight.data))
+            qp = Parameter(q, requires_grad=False)
+            sp = Parameter(s, requires_grad=False)
+            mod._parameters["weight"] = qp
+            mod.register_parameter("scale", sp)
+
+            def make_fwd(m):
+                def forward(x):
+                    return quantized_linear(x, m._parameters["weight"], m._parameters["scale"],
+                                            m._parameters.get("bias"))
+
+                return forward
+
+            mod.forward = make_fwd(mod)

@@ -1,0 +1,4 @@
+from .check_trace import CheckedListOfTraces, TraceCheckError, check_trace
+from .debug import DebugTransform, ProfileTransform, benchmark_n
+from .examine import examine, get_fusion_source, get_fusions
+from .memory import get_alloc_memory, tensor_bytes

@@ -1,0 +1,57 @@
+"""Trace consistency validator.
+
+Re-design of reference thunder/dev_utils/check_trace.py:23: versioned
+invariants over proxy def-use — every consumed proxy must be an argument or
+produced earlier; names unique; RETURN last. The sanity layer the reference
+exposes via DebugOptions.check_traces."""
+from __future__ import annotations
+
+from ..core.prims import PrimIDs
+from ..core.proxies import Proxy
+from ..core.trace import TraceCtx
+
+
+class TraceCheckError(AssertionError):
+    pass
+
+
+def check_trace(trace: TraceCtx) -> None:
+    defined: set[str] = {p.name for p in trace.args}
+    produced_at: dict[str, int] = {}
+    saw_return = False
+
+    for i, bsym in enumerate(trace.bound_symbols):
+        if saw_return:
+            raise TraceCheckError(f"bsym {i} ({bsym.sym.name}) appears after RETURN")
+        if bsym.sym.id in (PrimIDs.DEL,):
+            for p in bsym.flat_proxy_args():
+                if p.name not in defined:
+                    raise TraceCheckError(f"DEL of undefined proxy {p.name} at bsym {i}")
+                defined.discard(p.name)
+            continue
+        for p in bsym.flat_proxy_args():
+            if p.name not in defined:
+                raise TraceCheckError(
+                    f"bsym {i} ({bsym.sym.name}) consumes undefined proxy '{p.name}'"
+                )
+        for o in bsym.flat_proxy_outs():
+            if o.name in produced_at:
+                raise TraceCheckError(
+                    f"proxy '{o.name}' produced twice (bsyms {produced_at[o.name]} and {i})"
+                )
+            produced_at[o.name] = i
+            defined.add(o.name)
+        if bsym.sym.id == PrimIDs.RETURN:
+            saw_return = True
+
+    if not saw_return and trace.bound_symbols:
+        raise TraceCheckError("trace has no RETURN")
+
+
+class CheckedListOfTraces(list):
+    """List that validates traces as they are appended (reference
+    thunder/__init__.py:467 wraps trace history this way)."""
+
+    def append(self, trace):
+        check_trace(trace)
+        super().append(trace)

@@ -1,0 +1,92 @@
+"""Debug & profile transforms: per-symbol callbacks and jax.profiler ranges.
+
+Re-design of reference thunder/dev_utils/debug_transform.py:23
+(DebugTransform: pre/post callbacks per bsym) and
+nvtx_profile_transform.py:41 (NVTX ranges -> here jax.profiler.TraceAnnotation,
+visible in XLA/TensorBoard profiles)."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.symbol import BoundSymbol
+from ..core.trace import TraceCtx, from_trace
+from ..core.transform_common import Transform
+from ..core.prims import PrimIDs
+
+_STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
+
+
+class DebugTransform(Transform):
+    """Wrap every claimed bsym's impl with pre/post callbacks."""
+
+    def __init__(self, pre: Optional[Callable] = None, post: Optional[Callable] = None):
+        self.pre = pre
+        self.post = post
+
+    def transform_trace_post_optimization(self, trc: TraceCtx, *, compile_data=None) -> TraceCtx:
+        out = from_trace(trc)
+        new = []
+        for bsym in trc.bound_symbols:
+            if bsym.sym.id in _STRUCTURAL or bsym.impl is None:
+                new.append(bsym)
+                continue
+            new.append(bsym.replace(impl=self._wrap(bsym)))
+        out.bound_symbols = new
+        out.set_provenance("Debug transform")
+        return out
+
+    def _wrap(self, bsym: BoundSymbol):
+        impl, pre, post = bsym.impl, self.pre, self.post
+
+        def wrapped(*args, **kwargs):
+            if pre is not None:
+                pre(bsym, args, kwargs)
+            result = impl(*args, **kwargs)
+            if post is not None:
+                post(bsym, result)
+            return result
+
+        wrapped.__name__ = f"debug_{getattr(impl, '__name__', bsym.sym.name)}"
+        return wrapped
+
+
+class ProfileTransform(Transform):
+    """Annotate each op with jax.profiler.TraceAnnotation so fusion regions and
+    collectives show up named in TensorBoard/XLA profiles."""
+
+    def transform_trace_post_optimization(self, trc: TraceCtx, *, compile_data=None) -> TraceCtx:
+        import jax
+
+        out = from_trace(trc)
+        new = []
+        for bsym in trc.bound_symbols:
+            if bsym.sym.id in _STRUCTURAL or bsym.impl is None:
+                new.append(bsym)
+                continue
+            impl = bsym.impl
+            name = bsym.sym.name
+
+            def wrapped(*args, __impl=impl, __name=name, **kwargs):
+                with jax.profiler.TraceAnnotation(__name):
+                    return __impl(*args, **kwargs)
+
+            new.append(bsym.replace(impl=wrapped))
+        out.bound_symbols = new
+        out.set_provenance("Profile transform")
+        return out
+
+
+def benchmark_n(n: int, fn: Callable, *args, **kwargs) -> float:
+    """Median wallclock of n runs (reference thunder/dev_utils benchmark_n)."""
+    import time
+
+    import jax
+
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]

@@ -1,0 +1,71 @@
+"""examine(): pre-flight op-coverage checker + fusion introspection.
+
+Re-design of reference thunder/examine/__init__.py:52 (examine), :210
+(get_fusions). The reference intercepts torch calls via TorchFunctionMode;
+here the callable is traced directly and the report covers which recorded
+symbols have executor coverage."""
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core.prims import PrimIDs
+from ..core.symbol import BoundSymbol
+from ..extend import get_always_executors, get_default_executors
+
+_STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
+
+
+def examine(fn: Callable, *args, **kwargs) -> dict:
+    """Trace fn and report op coverage: which symbols were recorded, which
+    executors claim them, and any unclaimed ops."""
+    from .. import acquire_trace
+
+    trc, _, _, _ = acquire_trace(fn, args, kwargs)
+    executors = list(get_default_executors()) + list(get_always_executors())
+
+    used: dict[str, int] = {}
+    unclaimed: list[str] = []
+
+    def visit(bsym: BoundSymbol):
+        if bsym.sym.id in _STRUCTURAL:
+            return
+        key = f"{bsym.sym.module}.{bsym.sym.name}" if bsym.sym.module else bsym.sym.name
+        used[key] = used.get(key, 0) + 1
+        claimed = bsym.sym.python_impl is not None or any(
+            ex.get_impl(bsym.sym.id) is not None for ex in executors
+        )
+        if not claimed:
+            if bsym.subsymbols:
+                for sub in bsym.subsymbols:
+                    visit(sub)
+            else:
+                unclaimed.append(key)
+
+    for bsym in trc.bound_symbols:
+        visit(bsym)
+
+    report = {
+        "ops": used,
+        "unclaimed": sorted(set(unclaimed)),
+        "n_ops": sum(used.values()),
+        "supported": not unclaimed,
+    }
+    if unclaimed:
+        print(f"examine: {len(set(unclaimed))} op(s) lack executor support: {sorted(set(unclaimed))}")
+    else:
+        print(f"examine: all {report['n_ops']} recorded ops are supported")
+    return report
+
+
+def get_fusions(cfn) -> list:
+    """Fusion bsyms of the last computation trace (reference examine:210)."""
+    from .. import last_traces
+
+    trc = last_traces(cfn)[-1]
+    return [b for b in trc.bound_symbols if str(b.sym.id).startswith("xla.")]
+
+
+def get_fusion_source(cfn, index: int = 0) -> str:
+    """Printable subtrace of the index-th fusion (nvfuser-repro analog)."""
+    fusions = get_fusions(cfn)
+    return fusions[index].impl.subtrace.python()

@@ -1,0 +1,66 @@
+"""Static per-trace memory estimation.
+
+Re-design of reference thunder/examine/memory_calculation.py:151
+(get_alloc_memory): walk the trace accounting allocations, aliases and DELs
+to estimate peak live bytes — the planning tool for remat/batch-size choices
+on HBM-limited TPUs."""
+from __future__ import annotations
+
+from ..core.prims import PrimIDs
+from ..core.proxies import TensorProxy, variableify
+from ..core.symbol import OpTags
+from ..core.trace import TraceCtx
+
+_VIEW_IDS = {PrimIDs.RESHAPE, PrimIDs.TRANSPOSE, PrimIDs.SQUEEZE, PrimIDs.BROADCAST_IN_DIM}
+
+
+def tensor_bytes(t: TensorProxy) -> int:
+    return t.numel * t.dtype.bytes
+
+
+def get_alloc_memory(trace: TraceCtx) -> tuple[int, dict]:
+    """Returns (peak_bytes, {bsym_index: live_bytes_after})."""
+    live: dict = {}
+    peak = 0
+    timeline = {}
+
+    for p in trace.args:
+        if isinstance(p, TensorProxy):
+            live[p.name] = tensor_bytes(p)
+    current = sum(live.values())
+    peak = current
+
+    # last-use index per proxy for implicit frees (XLA frees dead buffers)
+    last_use: dict[str, int] = {}
+    for i, bsym in enumerate(trace.bound_symbols):
+        for p in bsym.flat_proxy_args():
+            last_use[p.name] = i
+    for p in _flat_output(trace):
+        last_use[p.name] = len(trace.bound_symbols)
+
+    for i, bsym in enumerate(trace.bound_symbols):
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args():
+                current -= live.pop(p.name, 0)
+            timeline[i] = current
+            continue
+        alias = bsym.sym.id in _VIEW_IDS
+        for o in bsym.flat_proxy_outs():
+            if isinstance(o, TensorProxy):
+                b = 0 if alias else tensor_bytes(o)
+                live[o.name] = b
+                current += b
+        peak = max(peak, current)
+        # implicit frees
+        for p in list(live):
+            if last_use.get(p, -1) <= i and p not in {a.name for a in trace.args}:
+                current -= live.pop(p)
+        timeline[i] = current
+    return peak, timeline
+
+
+def _flat_output(trace):
+    from ..core.codeutils import flat_proxies
+
+    out = trace.output
+    return flat_proxies(out) if out is not None else []
