@@ -66,8 +66,10 @@ unary_opinfos = [
     OpInfo(name="gelu_tanh", op=functools.partial(ltorch.gelu, approximate="tanh"),
            ref=functools.partial(jax.nn.gelu, approximate=True),
            sample_generator=elementwise_unary_samples, dtypes=F32_64, atol=1e-4, rtol=1e-4),
-    _u("isfinite", jnp.isfinite),
-    _u("isnan", jnp.isnan),
+    OpInfo(name="isfinite", op=ltorch.isfinite, ref=jnp.isfinite,
+           sample_generator=elementwise_unary_samples, dtypes=FLOATS, supports_grad=False),
+    OpInfo(name="isnan", op=ltorch.isnan, ref=jnp.isnan,
+           sample_generator=elementwise_unary_samples, dtypes=FLOATS, supports_grad=False),
 ]
 
 binary_opinfos = [
@@ -141,7 +143,12 @@ shape_opinfos = [
            sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3), dt), make_tensor(rng, (2, 3), dt)))]),
            dtypes=F32),
     OpInfo(name="split", op=lambda a: ltorch.split(a, 2, 1), ref=lambda a: jnp.split(a, [2, 4], axis=1),
-           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]), dtypes=F32),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="split_cat_roundtrip", op=lambda a: ltorch.cat(list(ltorch.split(a, 2, 1)), 1),
+           ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]),
+           dtypes=F32),
     OpInfo(name="flatten", op=ltorch.flatten, ref=lambda a: jnp.reshape(a, (-1,)),
            sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt),))]), dtypes=F32),
     OpInfo(name="unsqueeze", op=ltorch.unsqueeze, ref=lambda a, d: jnp.expand_dims(a, d),

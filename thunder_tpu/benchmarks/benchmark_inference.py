@@ -1,0 +1,60 @@
+"""Inference benchmark harness: throughput, ms/token, TTFT, TBOT.
+
+Counterpart of reference thunder/benchmarks/benchmark_inference.py:1-11.
+
+Usage:
+    python -m thunder_tpu.benchmarks.benchmark_inference --model_name tiny-llama2 \
+        --batch_size 1 --prompt_len 64 --max_new_tokens 64
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(args) -> dict:
+    from thunder_tpu.inference import GPTInference
+    from thunder_tpu.models.litgpt import Config, GPT
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    cfg = Config.from_name(args.model_name, block_size=max(args.prompt_len + args.max_new_tokens, 128))
+    gpt = GPT(cfg, dtype=dtype)
+    engine = GPTInference(gpt, dtype=dtype)
+
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch_size, args.prompt_len)))
+
+    # warmup (compile prefill + decode)
+    engine.generate(prompt, max_new_tokens=4)
+    out, m = engine.generate(prompt, max_new_tokens=args.max_new_tokens, temperature=args.temperature)
+
+    result = {
+        "model": args.model_name,
+        "batch_size": args.batch_size,
+        "prompt_len": args.prompt_len,
+        "new_tokens": m.n_new_tokens,
+        "ttft_ms": m.ttft_s * 1e3,
+        "tbot_ms": m.tbot_s * 1e3,
+        "tokens_per_sec": m.tokens_per_sec,
+        "ms_per_token": m.ms_per_token,
+    }
+    print(json.dumps(result, indent=2))
+    return result
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_name", default="tiny-llama2")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--prompt_len", type=int, default=64)
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    run(p.parse_args())
+
+
+if __name__ == "__main__":
+    main()

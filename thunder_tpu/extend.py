@@ -100,6 +100,51 @@ class TemporaryExecutor(OperatorExecutor):
         super().__init__(f"__ad_hoc_{TemporaryExecutor._counter}")
 
 
+class StatefulExecutor(OperatorExecutor):
+    """Executor whose ops carry persistent state objects across calls
+    (reference extend/__init__.py:284 — TransformerEngine's fp8 recipe state).
+    `register_stateful_operator` binds a state factory; the state instance is
+    created at claim time and threaded into every invocation."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._state_factories: dict = {}
+        self._states: dict = {}
+
+    def register_stateful_operator(self, name: str, state_factory, *, meta, fn, replaces=None) -> Symbol:
+        sym = self.register_operator(name, meta=meta, fn=self._bind_state(name, fn), replaces=replaces)
+        self._state_factories[sym.id] = state_factory
+        return sym
+
+    def _bind_state(self, name: str, fn):
+        def wrapped(*args, **kwargs):
+            sid = f"{self.name}.{name}"
+            state = self._states.get(sid)
+            if state is None:
+                state = self._state_factories[sid]()
+                self._states[sid] = state
+            return fn(state, *args, **kwargs)
+
+        return wrapped
+
+
+def single_op_executor(name: str, sym_name: str, *, meta, fn, replaces=None) -> OperatorExecutor:
+    """Create+register a one-op executor (reference extend/__init__.py:459)."""
+    ex = OperatorExecutor(name)
+    ex.register_operator(sym_name, meta=meta, fn=fn, replaces=replaces)
+    register_executor(ex)
+    return ex
+
+
+def deregister_executor(name_or_ex) -> None:
+    name = name_or_ex.name if isinstance(name_or_ex, Executor) else name_or_ex
+    _executor_registry.pop(name, None)
+    for lst in (_default_executors, _always_executors):
+        for e in list(lst):
+            if e.name == name:
+                lst.remove(e)
+
+
 # ---------------------------------------------------------------------------
 # global registry (reference extend/__init__.py:525-659)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,81 @@
+"""Distributed plugins: string-addressable DDP/FSDP/TP/CP bundles.
+
+Reference thunder/plugins/distributed.py:13,58 (DDP/FSDP plugins, mesh-aware
+2-D stacking at :118-155)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..plugins import Plugin, register_plugin
+from .mesh import DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS, make_mesh
+
+
+class _MeshPlugin(Plugin):
+    def __init__(self, mesh=None, n_devices: Optional[int] = None):
+        self._mesh = mesh
+        self._n = n_devices
+
+    def mesh(self, axis: str):
+        if self._mesh is not None:
+            return self._mesh
+        import jax
+
+        return make_mesh({axis: self._n or len(jax.devices())})
+
+
+class DDP(_MeshPlugin):
+    """plugins=[DDP()] → replicate params over all devices."""
+
+    def setup_transforms(self, transforms):
+        from .transforms import DDPTransform, DistPlan
+
+        self.pending = ("ddp", self.mesh(DP_AXIS))
+        return transforms
+
+    def apply_to(self, tmodule):
+        from .transforms import ddp
+
+        return ddp(tmodule, self.mesh(DP_AXIS))
+
+
+class FSDP(_MeshPlugin):
+    """plugins=[FSDP()] → ZeRO-3 shard over all devices; pass a 2-D mesh with
+    ('dp','fsdp') axes for hybrid sharding (reference plugins/distributed.py:118)."""
+
+    def apply_to(self, tmodule):
+        from .transforms import ddp, fsdp
+
+        mesh = self.mesh(FSDP_AXIS)
+        if "dp" in getattr(mesh, "axis_names", ()):
+            ddp(tmodule, mesh)
+        return fsdp(tmodule, mesh)
+
+
+class TensorParallel(_MeshPlugin):
+    def __init__(self, column: Sequence[str] = (), row: Sequence[str] = (), **kw):
+        super().__init__(**kw)
+        self.column = list(column)
+        self.row = list(row)
+
+    def apply_to(self, tmodule):
+        from .tensor_parallel import column_parallel, row_parallel
+
+        mesh = self.mesh(TP_AXIS)
+        if self.column:
+            column_parallel(tmodule, mesh, self.column)
+        if self.row:
+            row_parallel(tmodule, mesh, self.row)
+        return tmodule
+
+
+class ContextParallel(_MeshPlugin):
+    def apply_to(self, tmodule):
+        from .context_parallel import context_parallel
+
+        return context_parallel(tmodule, self.mesh(SP_AXIS))
+
+
+register_plugin("ddp", DDP)
+register_plugin("fsdp", FSDP)
+register_plugin("tp", TensorParallel)
+register_plugin("cp", ContextParallel)
