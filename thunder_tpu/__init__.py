@@ -233,6 +233,14 @@ def jit(
     if isinstance(fn, Module):
         return ThunderModule(fn, executors=executors, cache=cache, transforms=transforms,
                              disable_fusion=disable_fusion, **compile_options)
+    # torch.nn.Module -> __torch_function__ tracing frontend (lazy torch import)
+    if type(fn).__module__.partition(".")[0] == "torch" or any(
+        c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]
+    ):
+        from .interop.torch_frontend import compile_torch_module
+
+        return compile_torch_module(fn, executors=executors, cache=cache,
+                                    disable_fusion=disable_fusion, **compile_options)
     cd = CompileData(
         fn=fn,
         executors=resolve_executors(executors),
