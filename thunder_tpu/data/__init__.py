@@ -1,0 +1,126 @@
+"""Data pipeline: native (C++) mmap token loader with threaded prefetch.
+
+The reference delegates data loading to torch DataLoader workers; here the
+host-side batch assembly is a small C++ library (native/loader.cpp) compiled
+on first use, with a pure-numpy fallback when no compiler is available.
+Batches are (B, T+1) int32: inputs = batch[:, :-1], targets = batch[:, 1:]."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libttloader.so")
+_CPP_PATH = os.path.join(_NATIVE_DIR, "loader.cpp")
+_build_lock = threading.Lock()
+
+
+def _build_native() -> Optional[str]:
+    with _build_lock:
+        if os.path.exists(_SO_PATH):
+            return _SO_PATH
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 _CPP_PATH, "-o", _SO_PATH],
+                check=True, capture_output=True, timeout=120,
+            )
+            return _SO_PATH
+        except Exception:
+            return None
+
+
+_lib = None
+
+
+def _native_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = _build_native()
+    if so is None:
+        return None
+    lib = ctypes.CDLL(so)
+    lib.ttl_create.restype = ctypes.c_void_p
+    lib.ttl_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+                               ctypes.c_int64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.ttl_num_tokens.restype = ctypes.c_int64
+    lib.ttl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.ttl_next.restype = ctypes.c_int
+    lib.ttl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.ttl_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class TokenLoader:
+    """Random-offset (B, T+1) batch sampler over a binary token file.
+
+    next_batch() -> (inputs (B,T) int32, targets (B,T) int32) numpy arrays.
+    Uses the native prefetching loader when g++ is available."""
+
+    def __init__(self, path: str, batch_size: int, seq_len: int, *, token_bytes: int = 2,
+                 seed: int = 0, n_threads: int = 2, queue_depth: int = 4, native: bool = True):
+        self.path = path
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.token_bytes = token_bytes
+        self.span = seq_len + 1
+        self._handle = None
+        self._lib = _native_lib() if native else None
+        if self._lib is not None:
+            self._handle = self._lib.ttl_create(
+                path.encode(), token_bytes, batch_size, self.span, seed, n_threads, queue_depth
+            )
+            if not self._handle:
+                self._lib = None
+        if self._lib is None:
+            dtype = {1: np.uint8, 2: np.uint16, 4: np.int32}[token_bytes]
+            self._tokens = np.memmap(path, dtype=dtype, mode="r")
+            self._rng = np.random.RandomState(seed)
+        self._buf = np.empty((batch_size, self.span), np.int32)
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def num_tokens(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.ttl_num_tokens(self._handle))
+        return int(self._tokens.shape[0])
+
+    def next_batch(self):
+        if self._handle is not None:
+            rc = self._lib.ttl_next(self._handle, self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rc != 0:
+                raise RuntimeError("native loader failed")
+            batch = self._buf
+        else:
+            n = self._tokens.shape[0]
+            offs = self._rng.randint(0, n - self.span - 1, self.batch_size)
+            for i, o in enumerate(offs):
+                self._buf[i] = self._tokens[o: o + self.span].astype(np.int32)
+            batch = self._buf
+        return batch[:, :-1].copy(), batch[:, 1:].copy()
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ttl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def write_token_file(path: str, tokens: np.ndarray, token_bytes: int = 2) -> None:
+    dtype = {1: np.uint8, 2: np.uint16, 4: np.int32}[token_bytes]
+    np.asarray(tokens, dtype=dtype).tofile(path)
