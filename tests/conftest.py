@@ -13,6 +13,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The ambient environment pre-imports jax (sitecustomize on PYTHONPATH) with
+# JAX_PLATFORMS=axon, so the env vars above are read too late; force via config.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -22,28 +22,38 @@ _build_lock = threading.Lock()
 
 def _build_native() -> Optional[str]:
     with _build_lock:
-        if os.path.exists(_SO_PATH):
+        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_CPP_PATH):
             return _SO_PATH
+        # compile to a pid-unique temp path and rename atomically so a
+        # concurrent process never dlopens a half-written .so
+        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
         try:
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 _CPP_PATH, "-o", _SO_PATH],
+                 _CPP_PATH, "-o", tmp],
                 check=True, capture_output=True, timeout=120,
             )
+            os.replace(tmp, _SO_PATH)
             return _SO_PATH
         except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
 
 
 _lib = None
+_lib_failed = False
 
 
 def _native_lib():
-    global _lib
-    if _lib is not None:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
         return _lib
     so = _build_native()
     if so is None:
+        _lib_failed = True
         return None
     lib = ctypes.CDLL(so)
     lib.ttl_create.restype = ctypes.c_void_p
@@ -82,6 +92,11 @@ class TokenLoader:
         if self._lib is None:
             dtype = {1: np.uint8, 2: np.uint16, 4: np.int32}[token_bytes]
             self._tokens = np.memmap(path, dtype=dtype, mode="r")
+            if self._tokens.shape[0] < self.span:
+                raise ValueError(
+                    f"token file {path!r} has {self._tokens.shape[0]} tokens, "
+                    f"need at least seq_len+1={self.span}"
+                )
             self._rng = np.random.RandomState(seed)
         self._buf = np.empty((batch_size, self.span), np.int32)
 
@@ -103,7 +118,9 @@ class TokenLoader:
             batch = self._buf
         else:
             n = self._tokens.shape[0]
-            offs = self._rng.randint(0, n - self.span - 1, self.batch_size)
+            # max valid start offset is n - span (inclusive), matching the
+            # native path's uniform_int_distribution(0, n - span)
+            offs = self._rng.randint(0, n - self.span + 1, self.batch_size)
             for i, o in enumerate(offs):
                 self._buf[i] = self._tokens[o: o + self.span].astype(np.int32)
             batch = self._buf

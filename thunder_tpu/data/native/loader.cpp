@@ -21,8 +21,8 @@
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
+#include <map>
 #include <mutex>
-#include <queue>
 #include <random>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -42,7 +42,11 @@ struct Loader {
     int fd = -1;
 
     std::vector<std::thread> workers;
-    std::queue<std::vector<int32_t>> ready;
+    // batches keyed by batch index and served strictly in order, so the
+    // consumed sequence is deterministic given seed regardless of which
+    // worker finishes first
+    std::map<uint64_t, std::vector<int32_t>> ready;
+    uint64_t next_serve = 0;
     std::mutex mu;
     std::condition_variable cv_ready, cv_space;
     size_t queue_depth = 4;
@@ -59,25 +63,30 @@ struct Loader {
         }
     }
 
-    void worker(int wid) {
-        // splitmix-seeded per-worker RNG; batch index comes from the shared
-        // counter so the global sample sequence is deterministic given seed
-        std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ull + wid);
+    void worker() {
         const int64_t span = seqlen;  // seqlen already includes the +1 target
         while (!stop.load(std::memory_order_relaxed)) {
             std::vector<int32_t> buf(batch * span);
             uint64_t bidx = batch_counter.fetch_add(1);
+            // contents depend only on (seed, bidx); combined with in-order
+            // serving this makes the full stream reproducible
             std::mt19937_64 brng(seed ^ (bidx * 0xBF58476D1CE4E5B9ull));
-            std::uniform_int_distribution<int64_t> dist(0, n_tokens - span - 1);
+            // max start offset n_tokens - span: last sampled index is n_tokens-1
+            std::uniform_int_distribution<int64_t> dist(0, n_tokens - span);
             for (int64_t b = 0; b < batch; ++b) {
                 int64_t off = dist(brng);
                 for (int64_t t = 0; t < span; ++t) buf[b * span + t] = (int32_t)tok(off + t);
             }
             std::unique_lock<std::mutex> lk(mu);
-            cv_space.wait(lk, [&] { return ready.size() < queue_depth || stop.load(); });
+            // always admit the batch the consumer is waiting for, even when
+            // the ring is full — otherwise a straggler holding next_serve
+            // deadlocks against a full queue
+            cv_space.wait(lk, [&] {
+                return ready.size() < queue_depth || bidx == next_serve || stop.load();
+            });
             if (stop.load()) return;
-            ready.push(std::move(buf));
-            cv_ready.notify_one();
+            ready.emplace(bidx, std::move(buf));
+            cv_ready.notify_all();
         }
     }
 };
@@ -101,14 +110,14 @@ void* ttl_create(const char* path, int token_bytes, int64_t batch, int64_t seqle
     if (fstat(L->fd, &st) != 0) { ::close(L->fd); delete L; return nullptr; }
     L->file_bytes = (size_t)st.st_size;
     L->n_tokens = (int64_t)(L->file_bytes / token_bytes);
-    if (L->n_tokens < seqlen + 1) { ::close(L->fd); delete L; return nullptr; }
+    if (L->n_tokens < seqlen) { ::close(L->fd); delete L; return nullptr; }
     void* m = mmap(nullptr, L->file_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0);
     if (m == MAP_FAILED) { ::close(L->fd); delete L; return nullptr; }
     madvise(m, L->file_bytes, MADV_RANDOM);
     L->data = (const uint8_t*)m;
 
     int nt = n_threads > 0 ? n_threads : 2;
-    for (int i = 0; i < nt; ++i) L->workers.emplace_back([L, i] { L->worker(i); });
+    for (int i = 0; i < nt; ++i) L->workers.emplace_back([L] { L->worker(); });
     return L;
 }
 
@@ -120,11 +129,15 @@ int ttl_next(void* h, int32_t* out) {
     std::vector<int32_t> buf;
     {
         std::unique_lock<std::mutex> lk(L->mu);
-        L->cv_ready.wait(lk, [&] { return !L->ready.empty() || L->stop.load(); });
-        if (L->ready.empty()) return -1;
-        buf = std::move(L->ready.front());
-        L->ready.pop();
-        L->cv_space.notify_one();
+        L->cv_ready.wait(lk, [&] {
+            return L->ready.count(L->next_serve) || L->stop.load();
+        });
+        auto it = L->ready.find(L->next_serve);
+        if (it == L->ready.end()) return -1;
+        buf = std::move(it->second);
+        L->ready.erase(it);
+        L->next_serve++;
+        L->cv_space.notify_all();
     }
     std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
     return 0;
