@@ -1,0 +1,309 @@
+"""Tests for the extension-surface parity components: pattern matching,
+vjp_utils, the numpy language, custom ops, LoRA, gradient bucketing, and
+recipes (counterparts of reference thunder/tests/test_patterns.py,
+test_transforms.py LoRA cases, test_ddp.py bucketing cases, test_recipes)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+from thunder_tpu.training import TrainStep
+
+
+# ---------------------------------------------------------------------------
+# patterns
+# ---------------------------------------------------------------------------
+
+
+class TestPatterns:
+    def _trace(self, fn, *args):
+        from thunder_tpu import acquire_trace
+        from thunder_tpu.core.transform_common import flatten_to_prims
+
+        trc, *_ = acquire_trace(fn, args, {})
+        return flatten_to_prims(trc)
+
+    def test_match_mul_add_chain(self):
+        from thunder_tpu.core.patterns import Pattern, uses
+        from thunder_tpu.core.prims import PrimIDs
+
+        def f(a, b, c):
+            return a * b + c
+
+        trc = self._trace(f, jnp.ones((4,)), jnp.ones((4,)), jnp.ones((4,)))
+        p = (Pattern()
+             .match_op(PrimIDs.MUL, bind_out="prod")
+             .match_op(PrimIDs.ADD, where=uses("prod")))
+        matches = p.match(trc)
+        assert len(matches) == 1
+        state, indices = matches[0]
+        assert [trc.bound_symbols[i].sym.id for i in indices] == [PrimIDs.MUL, PrimIDs.ADD]
+
+    def test_no_match_when_disconnected(self):
+        from thunder_tpu.core.patterns import Pattern, uses
+        from thunder_tpu.core.prims import PrimIDs
+
+        def f(a, b, c):
+            return (a * b, c + c)  # add does not consume the mul
+
+        trc = self._trace(f, jnp.ones((4,)), jnp.ones((4,)), jnp.ones((4,)))
+        p = (Pattern()
+             .match_op(PrimIDs.MUL, bind_out="prod")
+             .match_op(PrimIDs.ADD, where=uses("prod")))
+        assert p.match(trc) == []
+
+    def test_replace_rewrites_and_preserves_numerics(self):
+        from thunder_tpu.core import prims
+        from thunder_tpu.core.patterns import Pattern, uses
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.core.transform_common import dce
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+
+        def f(a, b, c):
+            return a * b + c
+
+        x = jnp.asarray(np.random.RandomState(0).rand(4).astype(np.float32))
+        y = jnp.asarray(np.random.RandomState(1).rand(4).astype(np.float32))
+        z = jnp.asarray(np.random.RandomState(2).rand(4).astype(np.float32))
+        trc = self._trace(f, x, y, z)
+
+        p = (Pattern()
+             .match_op(PrimIDs.MUL, bind_args=("a", "b"), bind_out="prod")
+             .match_op(PrimIDs.ADD, where=uses("prod"), bind_args=(None, "c")))
+
+        def fma(a, b, c, prod=None):
+            return prims.add(prims.mul(prims.mul(a, b), 1.0), c)
+
+        new_trc = p.replace(trc, fma)
+        claimed = transform_for_execution(dce(new_trc), resolve_executors(None))
+        out = claimed.python_callable()(x, y, z)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * np.asarray(y) + np.asarray(z), atol=1e-6)
+
+    def test_intermediate_escape_blocks_match(self):
+        from thunder_tpu.core.patterns import Pattern, uses
+        from thunder_tpu.core.prims import PrimIDs
+
+        def f(a, b, c):
+            prod = a * b
+            return prod + c, prod * 2.0  # prod escapes
+
+        trc = self._trace(f, jnp.ones((4,)), jnp.ones((4,)), jnp.ones((4,)))
+        p = (Pattern()
+             .match_op(PrimIDs.MUL, bind_out="prod")
+             .match_op(PrimIDs.ADD, where=uses("prod")))
+        assert p.match(trc) == []
+
+
+# ---------------------------------------------------------------------------
+# vjp_utils
+# ---------------------------------------------------------------------------
+
+
+class TestVjpUtils:
+    def test_make_aug_forward_and_backward(self):
+        from thunder_tpu import acquire_trace
+        from thunder_tpu.core.vjp_utils import make_aug_forward_and_backward
+
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.core.transform_common import flatten_to_prims
+
+        def f(a, b):
+            return ltorch.mul(a, b)
+
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+        y = jnp.asarray(np.random.RandomState(1).rand(3, 4).astype(np.float32))
+        trc, *_ = acquire_trace(f, (x, y), {})
+        trc = flatten_to_prims(trc)
+        mul_bsym = next(b for b in trc.bound_symbols if b.sym.id == PrimIDs.MUL)
+        fwd_trc, bwd_trc = make_aug_forward_and_backward(mul_bsym)
+        assert "augmented_forward" in fwd_trc.name_of_fn()
+        assert "backward" in bwd_trc.name_of_fn()
+        # traces print and contain at least one op each
+        assert len(fwd_trc.bound_symbols) >= 1
+        assert len(bwd_trc.bound_symbols) >= 1
+        assert "def " in str(fwd_trc) and "def " in str(bwd_trc)
+
+    def test_missing_rule_raises(self):
+        from thunder_tpu.core.symbol import Symbol
+        from thunder_tpu.core.vjp_utils import make_aug_forward_and_backward
+
+        sym = Symbol("no_rule_op", lambda x: x, id="test.no_rule_op", is_prim=True)
+        bsym = sym.bind(jnp.ones(()), output=jnp.ones(()))
+        with pytest.raises(LookupError):
+            make_aug_forward_and_backward(bsym)
+
+
+# ---------------------------------------------------------------------------
+# numpy language
+# ---------------------------------------------------------------------------
+
+
+class TestNumpyLang:
+    def test_basic_ops(self, rng):
+        from thunder_tpu.ops import numpy_lang as tnp
+
+        x = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+        y = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+
+        def f(x, y):
+            return tnp.sum(tnp.multiply(x, y), axis=-1)
+
+        out = tt.jit(f)(x, y)
+        np.testing.assert_allclose(np.asarray(out), np.sum(np.asarray(x) * np.asarray(y), axis=-1), atol=1e-5)
+
+    def test_shape_and_linalg(self, rng):
+        from thunder_tpu.ops import numpy_lang as tnp
+
+        x = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+
+        def g(x):
+            return tnp.matmul(tnp.transpose(x), tnp.exp(x))
+
+        out = tt.jit(g)(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x).T @ np.exp(np.asarray(x)), rtol=2e-2)
+
+    def test_reductions_keepdims(self, rng):
+        from thunder_tpu.ops import numpy_lang as tnp
+
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+
+        def h(x):
+            return tnp.amax(tnp.power(tnp.absolute(x), 2.0), axis=0, keepdims=True)
+
+        out = tt.jit(h)(x)
+        np.testing.assert_allclose(np.asarray(out), np.max(np.abs(np.asarray(x)) ** 2, axis=0, keepdims=True),
+                                   atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# custom ops
+# ---------------------------------------------------------------------------
+
+
+class TestCustomOp:
+    def test_forward_and_vjp(self, rng):
+        from thunder_tpu.transforms.autodiff import ThunderValueAndGrad
+
+        @tt.custom_op("testlib.swish4", like=lambda x: x)
+        def swish4(x):
+            return x * jax.nn.sigmoid(4.0 * x)
+
+        @swish4.register_vjp
+        def swish4_vjp(x, g):
+            s = jax.nn.sigmoid(4.0 * x)
+            return g * (s + 4.0 * x * s * (1.0 - s))
+
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        xn = np.asarray(x)
+        out = tt.jit(lambda x: swish4(x))(x)
+        np.testing.assert_allclose(np.asarray(out), xn / (1 + np.exp(-4 * xn)) * 1.0 * xn / xn, atol=1e-5)
+
+        v = ThunderValueAndGrad(lambda x: ltorch.sum(swish4(x)), argnums=0)
+        _, grads = v(x)
+        s = 1 / (1 + np.exp(-4 * xn))
+        np.testing.assert_allclose(np.asarray(grads[0][0]), s + 4 * xn * s * (1 - s), atol=1e-4)
+
+    def test_requires_exactly_one_spec(self):
+        with pytest.raises(TypeError):
+            tt.custom_op("testlib.bad")(lambda x: x)
+        with pytest.raises(TypeError):
+            tt.custom_op("testlib.bad2", like=lambda x: x, meta=lambda x: x)(lambda x: x)
+
+
+# ---------------------------------------------------------------------------
+# LoRA
+# ---------------------------------------------------------------------------
+
+
+class _LoraNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32, seed=3)
+        self.fc2 = nn.Linear(32, 4, seed=4)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc2(ltorch.relu(self.fc1(x))), y)
+
+
+class TestLoRA:
+    def test_adapters_train_base_frozen(self, rng):
+        from thunder_tpu.transforms.lora import LORATransform
+
+        net = _LoraNet()
+        w1_before = np.asarray(net.fc1.weight.data).copy()
+        tm = tt.jit(net, transforms=[LORATransform(r=4, lora_alpha=8, target_modules=("fc1",))])
+        step = TrainStep(tm, optim.AdamW(lr=0.05))
+        x = jnp.asarray(rng.rand(8, 16).astype(np.float32))
+        y = jnp.asarray(rng.rand(8, 4).astype(np.float32))
+        l0 = float(step(x, y))
+        for _ in range(5):
+            step(x, y)
+        l1 = float(step(x, y))
+        assert l1 < l0
+        np.testing.assert_array_equal(w1_before, np.asarray(net.fc1.weight.data))
+        assert np.abs(np.asarray(net.fc1._parameters["lora_B"].data)).max() > 0
+
+    def test_no_match_raises(self):
+        from thunder_tpu.transforms.lora import LORATransform
+
+        with pytest.raises(ValueError):
+            tt.jit(_LoraNet(), transforms=[LORATransform(target_modules=("nonexistent",))])
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestGradBucketing:
+    def test_ddp_bucketing_matches_reference(self):
+        from thunder_tpu.parallel import ddp, make_mesh
+        from thunder_tpu.parallel.bucketing import GradBucketingTransform
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(16, 16), jnp.float32)
+        y = jnp.zeros((16, 4), jnp.float32)
+
+        m0 = _LoraNet()
+        sd = {k: np.asarray(v).copy() for k, v in m0.state_dict().items()}
+        ref_step = TrainStep(m0, optim.AdamW(lr=1e-2))
+        ref = [float(ref_step(x, y)) for _ in range(3)]
+
+        m1 = _LoraNet()
+        m1.load_state_dict(sd)
+        tm = tt.jit(m1, transforms=[GradBucketingTransform(bucket_size_in_mb=25)])
+        ddp(tm, make_mesh({"dp": 8}))
+        step = TrainStep(tm, optim.AdamW(lr=1e-2))
+        got = [float(step(x, y)) for _ in range(3)]
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+        bwd = str(step._vag._cs.last_backward_traces[0])
+        assert "dist.pack" in bwd and "dist.unpack" in bwd
+        # 4 per-param all-reduces collapsed into 1
+        assert bwd.count("dist.all_reduce") == 1
+
+
+# ---------------------------------------------------------------------------
+# recipes
+# ---------------------------------------------------------------------------
+
+
+class TestRecipes:
+    def test_resolve_named(self):
+        from thunder_tpu.recipes import BaseRecipe, HFTransformers, resolve_recipe
+
+        assert isinstance(resolve_recipe("base", None), BaseRecipe)
+        assert isinstance(resolve_recipe("hf-transformers", None), HFTransformers)
+        with pytest.raises(ValueError):
+            resolve_recipe("nope", None)
+
+    def test_hf_validation_rejects_non_hf(self):
+        from thunder_tpu.recipes import HFTransformers
+
+        with pytest.raises(ValueError):
+            HFTransformers().validate(_LoraNet())

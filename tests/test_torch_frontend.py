@@ -107,3 +107,44 @@ def test_unmapped_op_errors_loudly():
 
     with pytest.raises(Exception):
         compile_torch_module(Weird())(jnp.ones((4,), jnp.float32))
+
+
+def test_hf_llama_gqa_matches_eager():
+    """GQA head expansion + DynamicCache empty-cat handling (transformers
+    LlamaForCausalLM with num_key_value_heads < num_attention_heads)."""
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    ids = torch.randint(0, 256, (2, 16))
+    with torch.no_grad():
+        ref = model(input_ids=ids).logits.numpy()
+    ctm = compile_torch_module(model)
+    out = ctm(input_ids=ids)
+    logits = out["logits"] if isinstance(out, dict) else getattr(out, "logits", out[0])
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=1e-4)
+
+
+def test_hf_recipe_compile():
+    """tt.compile auto-detects PreTrainedModel -> HFTransformers recipe."""
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import thunder_tpu as tt
+    from thunder_tpu.recipes import HFTransformers, resolve_recipe
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2)
+    model = LlamaForCausalLM(cfg).eval()
+    assert isinstance(resolve_recipe("auto", model), HFTransformers)
+    cm = tt.compile(model)
+    ids = torch.randint(0, 64, (1, 8))
+    out = cm(input_ids=ids)
+    logits = out["logits"] if isinstance(out, dict) else getattr(out, "logits", out[0])
+    with torch.no_grad():
+        ref = model(input_ids=ids).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=1e-4)

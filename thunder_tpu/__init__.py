@@ -239,7 +239,7 @@ def jit(
     ):
         from .interop.torch_frontend import compile_torch_module
 
-        return compile_torch_module(fn, executors=executors, cache=cache,
+        return compile_torch_module(fn, executors=executors, cache=cache, transforms=transforms,
                                     disable_fusion=disable_fusion, **compile_options)
     cd = CompileData(
         fn=fn,
@@ -319,6 +319,12 @@ def examine(fn, *args, **kwargs):
     from .utils.examine import examine as _examine
 
     return _examine(fn, *args, **kwargs)
+
+
+def custom_op(qualname, *, like=None, meta=None, tags=()):
+    from .custom_op import custom_op as _custom_op
+
+    return _custom_op(qualname, like=like, meta=meta, tags=tags)
 
 
 def __getattr__(name):

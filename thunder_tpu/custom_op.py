@@ -1,0 +1,133 @@
+"""User-defined custom operations with optional autodiff.
+
+Re-design of reference thunder/torch/custom_op.py (_register_custom_op) and
+thunder/executors/custom_op_ex.py: users bring a concrete (jax) implementation
+— optionally a Pallas kernel — plus a shape meta and optional VJP, and get a
+Symbol usable inside traced functions, claimed like any builtin op and
+differentiated through the trace-level autodiff.
+
+    import thunder_tpu as tt
+
+    @tt.custom_op("mylib.swish4", like=lambda x: x)
+    def swish4(x):
+        return x * jax.nn.sigmoid(4.0 * x)
+
+    @swish4.register_vjp
+    def swish4_vjp(x, g):
+        s = jax.nn.sigmoid(4.0 * x)
+        return g * (s + 4.0 * x * s * (1 - s))
+
+``like`` gives the output spec: a callable mapping input proxies to an output
+proxy/shape-donor proxy (identity for elementwise ops). For full control pass
+``meta=`` instead. Implementations execute inside XLA fusion regions (they are
+jax-traceable), unlike the reference where custom ops are opaque CUDA calls.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from .core.proxies import TensorProxy
+from .core.symbol import Symbol
+from .extend import OperatorExecutor, register_executor
+
+# one shared executor hosts all user custom ops (reference custom_op_ex)
+custom_op_ex = OperatorExecutor("custom_op")
+register_executor(custom_op_ex)
+
+
+class CustomOp:
+    """The object returned by @custom_op: callable symbol + rule hooks."""
+
+    def __init__(self, sym: Symbol, fn: Callable):
+        self.sym = sym
+        self.fn = fn
+        self.__name__ = sym.name
+
+    def __call__(self, *args, **kwargs):
+        return self.sym(*args, **kwargs)
+
+    def register_vjp(self, vjp_fn: Callable) -> Callable:
+        """vjp_fn(*primal_args, *cotangents) -> grads (one per tensor arg).
+
+        vjp_fn is jax code: it becomes its own custom symbol (claimed and
+        XLA-fused like the forward). Residuals are the primal args
+        (recompute-friendly: the recomputation fuses into the backward
+        region)."""
+        from .transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+        sym = self.sym
+        state: dict = {}  # n_primals recorded by aug; vjp symbol built lazily
+
+        def vjp_meta(*args):
+            primals = args[: state["n_primals"]]
+            grads = tuple(
+                TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+                for a in primals if isinstance(a, TensorProxy)
+            )
+            return grads if len(grads) != 1 else grads[0]
+
+        def aug(*args, **kwargs):
+            state["n_primals"] = len(args)
+            return VJPResult(sym(*args, **kwargs), tuple(args))
+
+        def bwd(*residuals_and_cots):
+            bs = state.get("sym")
+            if bs is None:
+                bs = Symbol(f"{sym.name}_vjp", vjp_meta, id=f"{sym.id}_vjp",
+                            is_prim=True, module=sym.module, executor=custom_op_ex)
+                custom_op_ex.register_implementation(bs.id, vjp_fn)
+                state["sym"] = bs
+            return bs(*residuals_and_cots)
+
+        register_augmented_forward(sym.id)(aug)
+        register_backward(sym.id)(bwd)
+        return vjp_fn
+
+    def register_aug_fwd(self, aug_fn: Callable) -> Callable:
+        """Full control: aug_fn(*args) -> VJPResult(out, residuals)."""
+        from .transforms.autodiff import register_augmented_forward
+
+        register_augmented_forward(self.sym.id)(aug_fn)
+        return aug_fn
+
+    def register_bwd(self, bwd_fn: Callable) -> Callable:
+        from .transforms.autodiff import register_backward
+
+        register_backward(self.sym.id)(bwd_fn)
+        return bwd_fn
+
+
+def _meta_from_like(like: Callable) -> Callable:
+    def meta(*args, **kwargs):
+        donor = like(*args, **kwargs)
+        if isinstance(donor, TensorProxy):
+            return TensorProxy(shape=donor.shape, dtype=donor.dtype, device=donor.device)
+        if isinstance(donor, (tuple, list)):
+            return type(donor)(
+                TensorProxy(shape=d.shape, dtype=d.dtype, device=d.device) if isinstance(d, TensorProxy) else d
+                for d in donor
+            )
+        return donor
+
+    return meta
+
+
+def custom_op(qualname: str, *, like: Callable | None = None, meta: Callable | None = None,
+              tags: Sequence[str] = ()) -> Callable[[Callable], CustomOp]:
+    """Register a jax-implemented custom operation (see module docstring).
+
+    qualname: "namespace.opname" (single names get namespace "custom").
+    """
+    if (like is None) == (meta is None):
+        raise TypeError("custom_op requires exactly one of like= or meta=")
+    namespace, _, opname = qualname.rpartition(".")
+    namespace = namespace or "custom"
+    sym_meta = meta if meta is not None else _meta_from_like(like)
+
+    def deco(fn: Callable) -> CustomOp:
+        sym = Symbol(opname, sym_meta, id=qualname if "." in qualname else f"custom.{qualname}",
+                     is_prim=True, module=namespace, executor=custom_op_ex, tags=tuple(tags))
+        custom_op_ex.register_implementation(sym.id, fn)
+        return CustomOp(sym, fn)
+
+    return deco

@@ -174,7 +174,24 @@ def _rms_norm(input, normalized_shape, weight=None, eps=None):
 
 @_register(F.scaled_dot_product_attention)
 def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
+    # GQA: expand KV heads to match query heads (torch does this internally
+    # when enable_gqa=True; HF relies on it for num_key_value_heads < heads).
+    # Only 4-D (B, H, S, D) inputs with divisible head counts are GQA; 3-D
+    # sdpa with differing q/kv lengths is ordinary cross-attention.
+    if q.ndim == 4 and k.ndim == 4:
+        qh, kh = int(q.shape[1]), int(k.shape[1])
+        if qh != kh and kh > 0 and qh % kh == 0:
+            rep = qh // kh
+            k = _repeat_kv(k, rep)
+            v = _repeat_kv(v, rep)
     return ltorch.sdpa(q, k, v, attn_mask, dropout_p, is_causal, scale)
+
+
+def _repeat_kv(t, rep: int):
+    b, h, s, d = (int(x) for x in t.shape)
+    t = clang.unsqueeze(t, 2)
+    t = clang.expand(t, (b, h, rep, s, d))
+    return clang.reshape(t, (b, h * rep, s, d))
 
 
 @_register(F.cross_entropy)
@@ -232,7 +249,22 @@ def _pad(x, pad, mode="constant", value=None):
 
 @_register(torch.cat, torch.concat)
 def _cat(tensors, dim=0):
-    return ltorch.cat(list(tensors), dim)
+    ts = list(tensors)
+    # torch's legacy empty-cat: a 0-element rank-1 tensor (HF DynamicCache's
+    # initial state) is dropped when concatenated with higher-rank tensors
+    max_rank = max(getattr(t, "ndim", 0) for t in ts)
+    ts = [t for t in ts
+          if not (getattr(t, "ndim", 0) == 1 and _numel(t) == 0 and max_rank > 1)]
+    if len(ts) == 1:
+        return ts[0]
+    return ltorch.cat(ts, dim)
+
+
+def _numel(t) -> int:
+    n = 1
+    for s in getattr(t, "shape", ()):
+        n *= int(s)
+    return n
 
 
 @_register(torch.stack)
@@ -609,6 +641,17 @@ class CompiledTorchModule:
         return self.traced.params
 
     def __call__(self, *args, **kwargs):
+        def conv(x):
+            if isinstance(x, torch.Tensor):
+                return torch_to_jax(x)
+            if isinstance(x, (tuple, list)):
+                return type(x)(conv(e) for e in x)
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+
+        args = tuple(conv(a) for a in args)
+        kwargs = {k: conv(v) for k, v in kwargs.items()}
         return self._cfn(self.traced.params, args, kwargs)
 
 

@@ -1,0 +1,192 @@
+"""NumPy-flavored operation namespace: a demonstration second frontend language.
+
+Counterpart of reference thunder/numpy/__init__.py:19 (npsymbol): the same
+trace IR can host multiple user-facing op languages. Ops here follow numpy
+naming/semantics (e.g. ``np.add(x, y)``, ``amax`` with ``axis=``/``keepdims=``)
+but record the same clang/prims bsyms as ltorch, so every transform and
+executor applies unchanged. Usage::
+
+    import thunder_tpu as tt
+    from thunder_tpu.ops import numpy_lang as tnp
+
+    def f(x, y):
+        return tnp.sum(tnp.multiply(x, y), axis=-1)
+
+    cf = tt.jit(f)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core import dtypes, prims
+from ..core.symbol import OpTags, Symbol
+from . import clang
+
+_np_symbols: dict[str, Symbol] = {}
+
+
+def npsymbol(*, name: str, id: str | None = None, tags=()):
+    """Create a numpy-language composite Symbol (reference thunder/numpy/__init__.py:19)."""
+
+    def decorator(meta):
+        sym = Symbol(name, meta, id=id or f"numpy.{name}", module="tnp", tags=tags)
+        _np_symbols[sym.id] = sym
+        return sym
+
+    return decorator
+
+
+def get_symbol(id: str) -> Symbol:
+    return _np_symbols[id]
+
+
+# -- elementwise binary --
+
+
+@npsymbol(name="add")
+def add(x1, x2):
+    return clang.add(x1, x2)
+
+
+@npsymbol(name="subtract")
+def subtract(x1, x2):
+    return clang.sub(x1, x2)
+
+
+@npsymbol(name="multiply")
+def multiply(x1, x2):
+    return clang.mul(x1, x2)
+
+
+@npsymbol(name="divide")
+def divide(x1, x2):
+    return clang.true_divide(x1, x2)
+
+
+@npsymbol(name="power")
+def power(x1, x2):
+    return clang.pow_(x1, x2)
+
+
+@npsymbol(name="maximum")
+def maximum(x1, x2):
+    return clang.maximum(x1, x2)
+
+
+@npsymbol(name="minimum")
+def minimum(x1, x2):
+    return clang.minimum(x1, x2)
+
+
+# -- elementwise unary --
+
+
+@npsymbol(name="negative")
+def negative(x):
+    return prims.neg(x)
+
+
+@npsymbol(name="absolute")
+def absolute(x):
+    return prims.abs(x)
+
+
+@npsymbol(name="exp")
+def exp(x):
+    return prims.exp(x)
+
+
+@npsymbol(name="log")
+def log(x):
+    return prims.log(x)
+
+
+@npsymbol(name="sqrt")
+def sqrt(x):
+    return prims.sqrt(x)
+
+
+@npsymbol(name="tanh")
+def tanh(x):
+    return prims.tanh(x)
+
+
+@npsymbol(name="sin")
+def sin(x):
+    return prims.sin(x)
+
+
+@npsymbol(name="cos")
+def cos(x):
+    return prims.cos(x)
+
+
+# -- reductions (numpy calling convention: axis=, keepdims=) --
+
+
+@npsymbol(name="sum", tags=(OpTags.REDUCTION_OP,))
+def sum(a, axis=None, keepdims: bool = False):  # noqa: A001 — numpy name
+    return clang.sum_(a, dim=axis, keepdim=keepdims)
+
+
+@npsymbol(name="mean", tags=(OpTags.REDUCTION_OP,))
+def mean(a, axis=None, keepdims: bool = False):
+    return clang.mean(a, dim=axis, keepdim=keepdims)
+
+
+@npsymbol(name="amax", tags=(OpTags.REDUCTION_OP,))
+def amax(a, axis=None, keepdims: bool = False):
+    return clang.amax(a, dim=axis, keepdim=keepdims)
+
+
+@npsymbol(name="amin", tags=(OpTags.REDUCTION_OP,))
+def amin(a, axis=None, keepdims: bool = False):
+    return clang.amin(a, dim=axis, keepdim=keepdims)
+
+
+# -- shape --
+
+
+@npsymbol(name="reshape", tags=(OpTags.SHAPE_OP,))
+def reshape(a, newshape):
+    return clang.reshape(a, tuple(newshape))
+
+
+@npsymbol(name="transpose", tags=(OpTags.SHAPE_OP,))
+def transpose(a, axes: Optional[Sequence[int]] = None):
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    return clang.permute(a, tuple(axes))
+
+
+@npsymbol(name="concatenate", tags=(OpTags.SHAPE_OP,))
+def concatenate(arrays, axis: int = 0):
+    return clang.cat(list(arrays), dim=axis)
+
+
+@npsymbol(name="expand_dims", tags=(OpTags.SHAPE_OP,))
+def expand_dims(a, axis: int):
+    return clang.unsqueeze(a, axis)
+
+
+@npsymbol(name="squeeze", tags=(OpTags.SHAPE_OP,))
+def squeeze(a, axis: Optional[int] = None):
+    return clang.squeeze(a, axis)
+
+
+# -- linalg --
+
+
+@npsymbol(name="matmul", tags=(OpTags.MATMUL_OP,))
+def matmul(x1, x2):
+    return prims.matmul(x1, x2)
+
+
+@npsymbol(name="dot", tags=(OpTags.MATMUL_OP,))
+def dot(a, b):
+    return prims.matmul(a, b)
+
+
+@npsymbol(name="where")
+def where(condition, x, y):
+    return clang.where(condition, x, y)

@@ -51,11 +51,67 @@ class Recipe:
 
     @classmethod
     def get_for_model(cls, fn) -> "Recipe":
+        if _is_hf_model(fn):
+            return HFTransformers()
         return BaseRecipe()
 
 
 class BaseRecipe(Recipe):
     pass
+
+
+def _is_hf_model(fn) -> bool:
+    for klass in type(fn).__mro__[:-1]:
+        if klass.__module__.startswith("transformers.") and klass.__name__ == "PreTrainedModel":
+            return True
+    return False
+
+
+class HFTransformers(Recipe):
+    """HuggingFace-transformers recipe (reference thunder/recipes/hf_transformers.py:190).
+
+    Validates the model is a supported ``PreTrainedModel``, forces the eager/
+    sdpa attention implementation the torch frontend can trace (no
+    flash-attention-2 torch kernels), and compiles through the
+    ``__torch_function__`` frontend so Pallas claims sdpa/cross-entropy whole.
+    """
+
+    SUPPORTED_ARCH_SUFFIXES = ("ForCausalLM", "Model", "ForSequenceClassification",
+                               "ForQuestionAnswering", "LMHeadModel")
+
+    def validate(self, model) -> None:
+        if not _is_hf_model(model):
+            raise ValueError(
+                f"HFTransformers recipe expects a transformers PreTrainedModel, got {type(model)}")
+        name = type(model).__name__
+        if not any(name.endswith(s) for s in self.SUPPORTED_ARCH_SUFFIXES):
+            import warnings
+
+            warnings.warn(f"HFTransformers recipe has not been validated on {name}")
+
+    def apply(self, fn, *, plugins=None, **kwargs):
+        self.validate(fn)
+        cfg = getattr(fn, "config", None)
+        if cfg is not None and getattr(cfg, "_attn_implementation", None) not in (None, "eager", "sdpa"):
+            import warnings
+
+            warnings.warn(
+                f"HFTransformers recipe: switching model config attn_implementation "
+                f"{cfg._attn_implementation!r} -> 'sdpa' so the torch frontend can trace it "
+                f"(this also affects uncompiled use of the model)")
+            cfg._attn_implementation = "sdpa"
+        return super().apply(fn, plugins=plugins, **kwargs)
+
+
+_recipe_registry: dict = {
+    "base": BaseRecipe,
+    "default": BaseRecipe,
+    "hf-transformers": HFTransformers,
+}
+
+
+def register_recipe(name: str, recipe_cls) -> None:
+    _recipe_registry[name] = recipe_cls
 
 
 def resolve_recipe(recipe, fn) -> Recipe:
@@ -64,7 +120,8 @@ def resolve_recipe(recipe, fn) -> Recipe:
     if isinstance(recipe, Recipe):
         return recipe
     if isinstance(recipe, str):
-        if recipe in ("base", "default"):
-            return BaseRecipe()
-        raise ValueError(f"unknown recipe '{recipe}'")
+        cls = _recipe_registry.get(recipe)
+        if cls is None:
+            raise ValueError(f"unknown recipe '{recipe}' (known: {sorted(_recipe_registry)})")
+        return cls()
     raise TypeError(f"cannot resolve recipe {recipe!r}")

@@ -73,33 +73,48 @@ class TrainStep:
 
             traced.__name__ = f"dist_{getattr(inner, '__name__', 'step')}"
 
-        # argnums=0: the params dict is arg 0 of the traced wrapper; inside the
-        # jitted step params are raw arrays, so positional marking is required
-        vag = ThunderValueAndGrad(traced, argnums=0, transforms=self.tmodule._cfn._transforms)
+        # Frozen (requires_grad=False) params ride as a separate non-donated,
+        # non-differentiated arg so LoRA/quantized base weights stay untouched.
+        def traced_split(tparams: dict, frozen: dict, args: tuple, kwargs: dict):
+            return traced({**frozen, **tparams}, args, kwargs)
+
+        traced_split.__name__ = getattr(traced, "__name__", "step")
+
+        # argnums=0: the trainable params dict is arg 0 of the traced wrapper;
+        # inside the jitted step params are raw arrays, so positional marking
+        # is required
+        vag = ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
         self._vag = vag
 
-        def raw_step(param_arrays: dict, opt_state, args, kwargs):
-            loss, grads = vag(param_arrays, args, kwargs)
+        def raw_step(tparam_arrays: dict, frozen_arrays: dict, opt_state, args, kwargs):
+            loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
             param_grads = grads[0][0]
-            new_params, new_state = optimizer.update(param_arrays, param_grads, opt_state)
+            new_params, new_state = optimizer.update(tparam_arrays, param_grads, opt_state)
             return loss, new_params, new_state
 
-        donate = (0, 1) if self.donate else ()
+        donate = (0, 2) if self.donate else ()
         if plan is None:
             self._jitted = jax.jit(raw_step, donate_argnums=donate)
         else:
             self._jitted = _shard_mapped_step(raw_step, plan, self.tmodule, self.opt_state,
                                               batch_args, batch_kwargs, donate)
 
-    def __call__(self, *args, **kwargs):
+    def _split_params(self):
         params = self.tmodule.get_parameters()
-        param_arrays = {k: p.data for k, p in params.items()}
+        trainable = {k: p for k, p in params.items() if getattr(p, "requires_grad", True)}
+        frozen = {k: p for k, p in params.items() if k not in trainable}
+        return trainable, frozen
+
+    def __call__(self, *args, **kwargs):
+        trainable, frozen = self._split_params()
+        tparam_arrays = {k: p.data for k, p in trainable.items()}
+        frozen_arrays = {k: p.data for k, p in frozen.items()}
         if self.opt_state is None:
-            self.opt_state = self.optimizer.init(param_arrays)
+            self.opt_state = self.optimizer.init(tparam_arrays)
         if self._jitted is None:
             self._build(args, kwargs)
-        loss, new_params, self.opt_state = self._jitted(param_arrays, self.opt_state, args, kwargs)
-        for k, p in params.items():
+        loss, new_params, self.opt_state = self._jitted(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+        for k, p in trainable.items():
             p.data = new_params[k]
         self._step_count += 1
         return loss
@@ -147,14 +162,17 @@ def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwa
     collectives and overlaps them with compute."""
     from jax.sharding import PartitionSpec as P
 
-    params = {k: p.data for k, p in tmodule.get_parameters().items()}
-    param_specs = {k: plan.param_spec(k, v.ndim) for k, v in params.items()}
+    all_params = tmodule.get_parameters()
+    trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
+    frozen = {k: p.data for k, p in all_params.items() if k not in trainable}
+    param_specs = {k: plan.param_spec(k, v.ndim) for k, v in trainable.items()}
+    frozen_specs = {k: plan.param_spec(k, v.ndim) for k, v in frozen.items()}
     if opt_state is None:
         raise RuntimeError("opt_state must be initialized before building the distributed step")
     opt_specs = _opt_state_specs(opt_state, param_specs)
     args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_args)
     kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_kwargs)
-    in_specs = (param_specs, opt_specs, args_specs, kwargs_specs)
+    in_specs = (param_specs, frozen_specs, opt_specs, args_specs, kwargs_specs)
     out_specs = (P(), param_specs, opt_specs)
     try:
         smapped = jax.shard_map(raw_step, mesh=plan.mesh, in_specs=in_specs,

@@ -1,0 +1,113 @@
+"""LoRA: low-rank adaptation of Linear layers as a module transform.
+
+Re-design of reference thunder/transforms/qlora.py:15 (LORATransform: replace
+nn.Linear computation with frozen-W + A/B low-rank adapters in-trace). The
+transform freezes the base weight and adds trainable ``lora_A`` (r, in) /
+``lora_B`` (out, r) params; the forward becomes
+``x @ W.T + (alpha/r) * (x @ A.T) @ B.T``. Composes with int8 quantization
+(QLoRA: quantize base weight, keep adapters in bf16/f32) and with FSDP/TP
+(adapters are ordinary params picked up by the distributed transforms)."""
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.transform_common import Transform
+from ..nn.module import Parameter
+from ..ops import ltorch
+
+
+class LORATransform(Transform):
+    """Swap matching Linear modules for LoRA-adapted forwards.
+
+    Args:
+      r: adapter rank.
+      lora_alpha: scaling numerator (effective scale = alpha / r).
+      lora_dropout: dropout rate on the adapter input path (0 = off).
+      target_modules: substrings of qualified module names to adapt; empty =
+        every Linear (reference qlora.py matches by name list).
+    """
+
+    def __init__(self, *, r: int = 8, lora_alpha: int = 16, lora_dropout: float = 0.0,
+                 target_modules: Sequence[str] = (), seed: int = 0):
+        if lora_dropout > 0.0:
+            raise NotImplementedError(
+                "lora_dropout requires traced RNG-state plumbing (reference prims.py:161 "
+                "GET_AND_UPDATE_RNG_STATE), which thunder_tpu does not provide yet; "
+                "pass lora_dropout=0.0")
+        self.r = r
+        self.lora_alpha = lora_alpha
+        self.lora_dropout = lora_dropout
+        self.target_modules = tuple(target_modules)
+        self.seed = seed
+
+    def _matches(self, name: str) -> bool:
+        if not self.target_modules:
+            return True
+        return any(t in name for t in self.target_modules)
+
+    def transform_module(self, tmodule) -> None:
+        from .. import nn as _nn
+
+        root = tmodule.module if hasattr(tmodule, "module") else tmodule
+        key = jax.random.PRNGKey(self.seed)
+        n_adapted = 0
+        for name, mod in list(root.named_modules()):
+            if not isinstance(mod, _nn.Linear) or not self._matches(name):
+                continue
+            key, ka = jax.random.split(key)
+            in_f, out_f = mod.in_features, mod.out_features
+            w_dtype = jnp.asarray(mod.weight.data).dtype
+            # Kaiming-uniform A, zero B: adapter starts as identity (standard LoRA init)
+            bound = 1.0 / math.sqrt(in_f)
+            lora_a = Parameter(jax.random.uniform(ka, (self.r, in_f), w_dtype, -bound, bound))
+            lora_b = Parameter(jnp.zeros((out_f, self.r), w_dtype))
+            mod.weight.requires_grad = False
+            if getattr(mod, "bias", None) is not None:
+                mod.bias.requires_grad = False
+            mod.register_parameter("lora_A", lora_a)
+            mod.register_parameter("lora_B", lora_b)
+            scale = self.lora_alpha / self.r
+            mod._lora_scale = scale
+            mod.forward = _make_lora_forward(mod, scale, self.lora_dropout)
+            n_adapted += 1
+        if n_adapted == 0:
+            raise ValueError(
+                f"LORATransform matched no Linear modules (targets={self.target_modules!r})")
+
+
+def _make_lora_forward(mod, scale: float, dropout: float) -> Callable:
+    def forward(x):
+        base = ltorch.linear(x, mod._parameters["weight"], mod._parameters.get("bias"))
+        h = x
+        if dropout > 0.0:
+            h = ltorch.dropout(h, p=dropout)
+        down = ltorch.linear(h, mod._parameters["lora_A"], None)
+        up = ltorch.linear(down, mod._parameters["lora_B"], None)
+        return ltorch.add(base, ltorch.mul(up, scale))
+
+    return forward
+
+
+def merge_lora_weights(tmodule) -> None:
+    """Fold adapters back into base weights (W += scale * B @ A) for
+    adapter-free inference; removes the adapter params."""
+    from .. import nn as _nn
+
+    root = tmodule.module if hasattr(tmodule, "module") else tmodule
+    for _, mod in list(root.named_modules()):
+        params = getattr(mod, "_parameters", {})
+        if "lora_A" not in params or "lora_B" not in params:
+            continue
+        a = jnp.asarray(params["lora_A"].data)
+        b = jnp.asarray(params["lora_B"].data)
+        w = jnp.asarray(params["weight"].data)
+        scale = getattr(mod, "_lora_scale", 1.0)
+        params["weight"] = Parameter(w + scale * (b @ a), requires_grad=False)
+        del mod._parameters["lora_A"]
+        del mod._parameters["lora_B"]
+        # restore the stock Linear forward
+        mod.forward = _nn.Linear.forward.__get__(mod, type(mod))
