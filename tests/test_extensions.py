@@ -307,3 +307,59 @@ class TestRecipes:
 
         with pytest.raises(ValueError):
             HFTransformers().validate(_LoraNet())
+
+
+class TestCustomOpArity:
+    def test_optional_arg_two_arities(self, rng):
+        from thunder_tpu.transforms.autodiff import ThunderValueAndGrad
+
+        @tt.custom_op("testlib2.scale_shift", like=lambda x, s=None: x)
+        def scale_shift(x, s=None):
+            return x * 2.0 + (s if s is not None else 0.0)
+
+        @scale_shift.register_vjp
+        def scale_shift_vjp(*args):
+            g = args[-1]
+            if len(args) == 3:
+                return g * 2.0, g
+            return g * 2.0
+
+        x = jnp.asarray(rng.rand(4).astype(np.float32))
+        s = jnp.asarray(rng.rand(4).astype(np.float32))
+
+        def f(x, s):
+            return ltorch.sum(ltorch.add(scale_shift(x), scale_shift(x, s)))
+
+        _, grads = ThunderValueAndGrad(f, argnums=(0, 1))(x, s)
+        np.testing.assert_allclose(np.asarray(grads[0][0]), 4.0)
+        np.testing.assert_allclose(np.asarray(grads[0][1]), 1.0)
+
+
+class TestPatternChained:
+    def test_chained_matches_rename_into_splices(self, rng):
+        from thunder_tpu import acquire_trace
+        from thunder_tpu.core import prims
+        from thunder_tpu.core.patterns import Pattern, uses
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.core.transform_common import dce, flatten_to_prims
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+
+        def g2(a, b, c, d, e):
+            return (a * b + c) * d + e
+
+        args = [jnp.asarray(rng.rand(4).astype(np.float32)) for _ in range(5)]
+        trc, *_ = acquire_trace(g2, tuple(args), {})
+        trc = flatten_to_prims(trc)
+        p = (Pattern()
+             .match_op(PrimIDs.MUL, bind_args=("a", "b"), bind_out="prod")
+             .match_op(PrimIDs.ADD, where=uses("prod"), bind_args=(None, "c")))
+
+        def fma(a, b, c, prod=None):
+            return prims.add(prims.mul(a, b), c)
+
+        new_trc = p.replace(trc, fma)
+        claimed = transform_for_execution(dce(new_trc), resolve_executors(None))
+        out = claimed.python_callable()(*args)
+        an = [np.asarray(a) for a in args]
+        np.testing.assert_allclose(np.asarray(out), (an[0] * an[1] + an[2]) * an[3] + an[4], atol=1e-5)

@@ -1,0 +1,168 @@
+"""General-jit (interpreter frontend) end-to-end tests: provenance-tracked
+captures, prologue generation, constant-values cache semantics (counterpart
+of reference thunder/tests/test_jit_general.py)."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.ops import ltorch
+
+
+@pytest.fixture
+def x(rng):
+    return jnp.asarray(rng.rand(2, 8).astype(np.float32))
+
+
+class TestCaptures:
+    def test_global_tensor_capture(self, rng, x):
+        global _W
+        _W = jnp.asarray(rng.rand(8, 4).astype(np.float32))
+
+        def f(x):
+            return ltorch.matmul(x, _W)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) @ np.asarray(_W), atol=1e-5)
+        assert cf.cache_misses == 1
+        cf(x)
+        assert cf.cache_hits == 1
+
+        # value update flows through the prologue without recompiling
+        _W = jnp.asarray(rng.rand(8, 4).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) @ np.asarray(_W), atol=1e-5)
+        assert cf.cache_misses == 1
+
+        # shape change invalidates (prologue check raises -> recompile)
+        _W = jnp.asarray(rng.rand(8, 6).astype(np.float32))
+        assert cf(x).shape == (2, 6)
+        assert cf.cache_misses == 2
+
+    def test_closure_capture(self, rng, x):
+        b = jnp.asarray(rng.rand(8).astype(np.float32))
+
+        def f(x):
+            return ltorch.add(x, b)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) + np.asarray(b), atol=1e-6)
+        pro = str(cf._cs.last_prologue_traces[0])
+        assert "unpack_closure" in pro
+
+    def test_scalar_guard_recompiles(self, rng, x):
+        global _K
+        _K = 3.0
+
+        def f(x):
+            return ltorch.mul(x, _K)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) * 3.0, atol=1e-6)
+        _K = 5.0
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) * 5.0, atol=1e-6)
+        assert cf.cache_misses == 2
+
+    def test_attr_chain_capture_of_model_object(self, rng, x):
+        class MLP:
+            def __init__(self):
+                self.weights = [jnp.asarray(rng.randn(8, 16).astype(np.float32) / math.sqrt(8)),
+                                jnp.asarray(rng.randn(16, 4).astype(np.float32) / 4.0)]
+                self.bias = jnp.asarray(np.zeros(4, np.float32))
+
+            def __call__(self, h):
+                for i, w in enumerate(self.weights):
+                    h = ltorch.matmul(h, w)
+                    if i == 0:
+                        h = ltorch.relu(h)
+                return h + self.bias
+
+        model = MLP()
+
+        def fwd(x):
+            return model(x)
+
+        cf = tt.jit(fwd, interpretation="python interpreter")
+
+        def ref():
+            h = np.asarray(x)
+            h = np.maximum(h @ np.asarray(model.weights[0]), 0)
+            return h @ np.asarray(model.weights[1]) + np.asarray(model.bias)
+
+        np.testing.assert_allclose(np.asarray(cf(x)), ref(), atol=1e-4)
+        pro = str(cf._cs.last_prologue_traces[0])
+        assert "unpack_attr" in pro and "unpack_item" in pro
+
+        # in-place param update visible on the next call, no recompile
+        model.weights[0] = model.weights[0] * 2
+        np.testing.assert_allclose(np.asarray(cf(x)), ref(), atol=1e-4)
+        assert cf.cache_misses == 1
+
+    def test_instance_directly_jitted(self, rng, x):
+        class Scaler:
+            def __init__(self):
+                self.s = jnp.asarray(np.float32(2.0) * np.ones(8, np.float32))
+
+            def __call__(self, h):
+                return ltorch.mul(h, self.s)
+
+        cf = tt.jit(Scaler(), interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x)), np.asarray(x) * 2.0, atol=1e-6)
+
+
+class TestSemantics:
+    def test_python_control_flow_specializes(self, rng, x):
+        def f(x, mode):
+            if mode == "double":
+                return ltorch.mul(x, 2.0)
+            return ltorch.mul(x, 3.0)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x, "double")), np.asarray(x) * 2, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cf(x, "triple")), np.asarray(x) * 3, atol=1e-6)
+        assert cf.cache_misses == 2  # one specialization per mode
+
+    def test_data_dependent_branch_errors(self, rng, x):
+        from thunder_tpu.frontend.interpreter import InterpreterError
+
+        def f(x):
+            if ltorch.sum(x) > 0:  # bool(TensorProxy)
+                return x
+            return ltorch.neg(x)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        with pytest.raises((InterpreterError, RuntimeError)):
+            cf(x)
+
+    def test_sharp_edge_error_mode(self, rng, x):
+        global _SIDE
+        _SIDE = 0
+
+        def f(x):
+            global _SIDE
+            _SIDE = 1
+            return ltorch.mul(x, 2.0)
+
+        cf = tt.jit(f, interpretation="python interpreter", sharp_edges="error")
+        from thunder_tpu.frontend.interpreter import InterpreterError
+
+        with pytest.raises(InterpreterError, match="sharp edge"):
+            cf(x)
+
+    def test_tensor_method_and_operator_dispatch(self, rng, x):
+        def f(x):
+            y = x * 2.0 + 1.0      # proxy operators
+            return y.sum()          # proxy method
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x)), (np.asarray(x) * 2 + 1).sum(), rtol=1e-5)
+
+    def test_loops_over_python_values(self, rng, x):
+        def f(x, n):
+            for _ in range(n):
+                x = ltorch.mul(x, 1.5)
+            return x
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        np.testing.assert_allclose(np.asarray(cf(x, 3)), np.asarray(x) * 1.5 ** 3, rtol=1e-5)

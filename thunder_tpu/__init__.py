@@ -225,11 +225,31 @@ def jit(
     cache: str = "constant values",
     transforms: Sequence[Transform] | None = None,
     disable_fusion: bool = False,
+    interpretation: str | None = None,
+    sharp_edges: str = "allow",
     **compile_options,
 ):
-    """Compile a callable or Module for TPU execution (reference thunder/__init__.py:315)."""
+    """Compile a callable or Module for TPU execution (reference thunder/__init__.py:315).
+
+    interpretation="python interpreter" acquires the program with the bytecode
+    interpreter frontend (provenance-tracked captures, generated prologues) —
+    required for arbitrary callables that close over tensors/modules; the
+    default direct proxy tracing is faster to compile for framework-native code.
+    """
     from .nn.module import Module, ThunderModule
 
+    if interpretation is not None:
+        if interpretation not in ("python interpreter", "interpreter"):
+            raise ValueError(f"unknown interpretation mode {interpretation!r}")
+        from .frontend.compiled import InterpretedFunction
+
+        return InterpretedFunction(fn, executors=executors, sharp_edges=sharp_edges,
+                                   transforms=transforms or (), cache=cache,
+                                   disable_fusion=disable_fusion, **compile_options)
+    if sharp_edges != "allow":
+        raise ValueError(
+            "sharp_edges checking requires the bytecode-interpreter frontend: "
+            "pass interpretation='python interpreter'")
     if isinstance(fn, Module):
         return ThunderModule(fn, executors=executors, cache=cache, transforms=transforms,
                              disable_fusion=disable_fusion, **compile_options)
@@ -332,6 +352,6 @@ def __getattr__(name):
     import importlib
 
     if name in ("nn", "optim", "models", "parallel", "training", "inference",
-                "transforms", "utils", "benchmarks", "recipes", "plugins"):
+                "transforms", "utils", "benchmarks", "recipes", "plugins", "frontend"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'thunder_tpu' has no attribute '{name}'")

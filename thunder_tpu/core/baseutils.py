@@ -38,6 +38,12 @@ def sequencify(x: Any) -> Sequence:
 _number_types = (int, float, bool, complex)
 
 
+def shape_numel(shape) -> int:
+    import math
+
+    return int(math.prod(shape)) if shape else 1
+
+
 def is_number(x: Any) -> bool:
     return isinstance(x, Number) or isinstance(x, _number_types)
 

@@ -182,7 +182,10 @@ class Pattern:
         out_bsyms: list[BoundSymbol] = []
         for i, bsym in enumerate(trace.bound_symbols):
             if i in splice:
-                out_bsyms.extend(splice[i])
+                # spliced builder bsyms also need the rename: with chained
+                # matches a later builder may consume an earlier match's
+                # (now-dropped) output
+                out_bsyms.extend(b.replace(args=sub(b.args), kwargs=sub(b.kwargs)) for b in splice[i])
             if i in drop:
                 continue
             out_bsyms.append(bsym.replace(args=sub(bsym.args), kwargs=sub(bsym.kwargs)))

@@ -37,6 +37,12 @@ class PrimIDs(Enum):
     CHECK_TENSOR_SHAPE_AND_METADATA = auto()
     CHECK_NUMBER_TYPE_AND_VALUE = auto()
     CHECK_LITERAL_LIKE = auto()
+    # prologue unpacks (reference prims.py UNPACK_* family) — extract captured
+    # values (globals / closure cells / attribute & item chains) at call time
+    UNPACK_GLOBAL = auto()
+    UNPACK_CLOSURE = auto()
+    UNPACK_ATTR = auto()
+    UNPACK_ITEM = auto()
     # dtype/device movement
     CONVERT_ELEMENT_TYPE = auto()
     DEVICE_PUT = auto()
@@ -256,6 +262,43 @@ check_number_type_and_value = make_prim(
     tags=(OpTags.DONT_DCE,),
     python_impl=_check_number_impl,
 )
+
+
+# prologue unpacks (reference UNPACK_* prims). The output proxy is created by
+# the prologue builder (which holds the concrete captured value at trace time)
+# and attached via Symbol.bind(..., output=proxy); python_impls do the real
+# extraction at call time.
+def _unpack_out_meta(*args):
+    return None
+
+
+def _unpack_global_impl(fn, name):
+    return fn.__globals__[name]
+
+
+def _unpack_closure_impl(fn, name):
+    for nm, cell in zip(fn.__code__.co_freevars, fn.__closure__ or ()):
+        if nm == name:
+            return cell.cell_contents
+    raise AssertionError(f"prologue: no closure cell named '{name}'")
+
+
+def _unpack_attr_impl(obj, name):
+    return getattr(obj, name)
+
+
+def _unpack_item_impl(obj, key):
+    return obj[key]
+
+
+unpack_global = make_prim(PrimIDs.UNPACK_GLOBAL, "unpack_global", _unpack_out_meta,
+                          tags=(OpTags.DONT_DCE,), python_impl=_unpack_global_impl)
+unpack_closure = make_prim(PrimIDs.UNPACK_CLOSURE, "unpack_closure", _unpack_out_meta,
+                           tags=(OpTags.DONT_DCE,), python_impl=_unpack_closure_impl)
+unpack_attr = make_prim(PrimIDs.UNPACK_ATTR, "unpack_attr", _unpack_out_meta,
+                        tags=(OpTags.DONT_DCE,), python_impl=_unpack_attr_impl)
+unpack_item = make_prim(PrimIDs.UNPACK_ITEM, "unpack_item", _unpack_out_meta,
+                        tags=(OpTags.DONT_DCE,), python_impl=_unpack_item_impl)
 
 
 # ---------------------------------------------------------------------------

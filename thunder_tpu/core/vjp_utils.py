@@ -16,11 +16,10 @@ from .trace import TraceCtx, tracectx
 from . import prims
 
 
-def _clone_proxy_into(trc: TraceCtx, p):
+def _clone_proxy(p):
     if isinstance(p, TensorProxy):
-        q = TensorProxy(p.name, shape=p.shape, dtype=p.dtype, device=p.device,
-                        requires_grad=p.requires_grad)
-        return q
+        return TensorProxy(p.name, shape=p.shape, dtype=p.dtype, device=p.device,
+                           requires_grad=p.requires_grad)
     return p
 
 
@@ -43,7 +42,7 @@ def make_aug_forward_and_backward(bsym: BoundSymbol) -> tuple[Callable, Callable
     fwd_trc = TraceCtx(None)
     fwd_trc._name = f"augmented_forward_{_ident(bsym.sym.name)}"
     with tracectx(fwd_trc):
-        arg_proxies = tuple(_clone_proxy_into(fwd_trc, a) for a in bsym.args)
+        arg_proxies = tuple(_clone_proxy(a) for a in bsym.args)
         for p in arg_proxies:
             if isinstance(p, Proxy):
                 fwd_trc.add_name(p.name)
@@ -56,7 +55,7 @@ def make_aug_forward_and_backward(bsym: BoundSymbol) -> tuple[Callable, Callable
     bwd_trc = TraceCtx(None)
     bwd_trc._name = f"backward_{_ident(bsym.sym.name)}"
     with tracectx(bwd_trc):
-        res_proxies = tuple(_clone_proxy_into(bwd_trc, r) for r in residuals)
+        res_proxies = tuple(_clone_proxy(r) for r in residuals)
         outs = res.out if isinstance(res.out, (tuple, list)) else (res.out,)
         cot_proxies = tuple(
             TensorProxy(f"g{i}", shape=o.shape, dtype=o.dtype, device=o.device)

@@ -56,28 +56,32 @@ class CustomOp:
         from .transforms.autodiff import VJPResult, register_augmented_forward, register_backward
 
         sym = self.sym
-        state: dict = {}  # n_primals recorded by aug; vjp symbol built lazily
+        vjp_syms: dict[int, Symbol] = {}  # one vjp symbol per call-site arity
 
-        def vjp_meta(*args):
-            primals = args[: state["n_primals"]]
-            grads = tuple(
-                TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
-                for a in primals if isinstance(a, TensorProxy)
-            )
-            return grads if len(grads) != 1 else grads[0]
-
-        def aug(*args, **kwargs):
-            state["n_primals"] = len(args)
-            return VJPResult(sym(*args, **kwargs), tuple(args))
-
-        def bwd(*residuals_and_cots):
-            bs = state.get("sym")
+        def make_vjp_sym(n_primals: int) -> Symbol:
+            bs = vjp_syms.get(n_primals)
             if bs is None:
-                bs = Symbol(f"{sym.name}_vjp", vjp_meta, id=f"{sym.id}_vjp",
+                def vjp_meta(*args):
+                    primals = args[:n_primals]
+                    grads = tuple(
+                        TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+                        for a in primals if isinstance(a, TensorProxy)
+                    )
+                    return grads if len(grads) != 1 else grads[0]
+
+                bs = Symbol(f"{sym.name}_vjp", vjp_meta, id=f"{sym.id}_vjp{n_primals}",
                             is_prim=True, module=sym.module, executor=custom_op_ex)
                 custom_op_ex.register_implementation(bs.id, vjp_fn)
-                state["sym"] = bs
-            return bs(*residuals_and_cots)
+                vjp_syms[n_primals] = bs
+            return bs
+
+        def aug(*args, **kwargs):
+            # arity travels in the residuals so each call site's backward
+            # slices primals/cotangents correctly
+            return VJPResult(sym(*args, **kwargs), (len(args), *args))
+
+        def bwd(n_primals, *residuals_and_cots):
+            return make_vjp_sym(n_primals)(*residuals_and_cots)
 
         register_augmented_forward(sym.id)(aug)
         register_backward(sym.id)(bwd)

@@ -1,0 +1,135 @@
+"""The callable produced by jit(..., interpretation="python interpreter").
+
+Mirrors reference thunder/__init__.py:695-743 semantics: cache entries hold
+(prologue, computation) callables, and a cache *hit is the first prologue that
+runs without raising* — the prologue both re-extracts captured values (so
+updated parameters flow in) and validates metadata/guarded scalars (so any
+environment change that invalidates the trace forces recompilation).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from ..common import CompileStats
+from ..core.pytree import tree_flatten
+from ..core.transform_common import dce
+from .jit_ext import _is_tensor_like, _unwrap_param, general_jit
+
+
+class InterpretedEntry:
+    __slots__ = ("prologue_fn", "computation_fn", "prologue_trc", "computation_trc", "shape_key")
+
+    def __init__(self, prologue_fn, computation_fn, prologue_trc, computation_trc, shape_key):
+        self.prologue_fn = prologue_fn
+        self.computation_fn = computation_fn
+        self.prologue_trc = prologue_trc
+        self.computation_trc = computation_trc
+        self.shape_key = shape_key
+
+
+class InterpretedFunction:
+    """jit-compiled via the bytecode interpreter frontend."""
+
+    def __init__(self, fn: Callable, *, executors=None, sharp_edges: str = "allow",
+                 transforms: Sequence = (), lookasides: dict | None = None,
+                 cache: str = "constant values", disable_fusion: bool = False,
+                 **compile_options):
+        if cache not in ("constant values", "no caching"):
+            raise ValueError(
+                f"cache={cache!r} is not supported by the interpreter frontend "
+                f"(supported: 'constant values', 'no caching')")
+        self.fn = fn
+        self.executors = executors
+        self.sharp_edges = sharp_edges
+        self.transforms = list(transforms or ())
+        self.lookasides = lookasides
+        self.cache_option = cache
+        self.disable_fusion = disable_fusion
+        self._entries: list[InterpretedEntry] = []
+        self._cs = CompileStats()
+        self.__name__ = getattr(fn, "__name__", type(fn).__name__)
+
+    def _shape_key(self, leaves, mask):
+        key = []
+        for leaf, is_t in zip(leaves, mask):
+            if is_t:
+                key.append(("T", tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                try:
+                    hash(leaf)
+                    key.append(("S", leaf))
+                except TypeError:
+                    key.append(("S", repr(leaf)))
+        return tuple(key)
+
+    def _compile(self, args, kwargs, shape_key) -> InterpretedEntry:
+        from ..executors.passes import transform_for_execution
+        from ..extend import resolve_executors
+
+        cs = self._cs
+        t0 = time.perf_counter_ns()
+        res, treedef, mask, leaves = general_jit(self.fn, args, kwargs,
+                                                 sharp_edges=self.sharp_edges,
+                                                 lookasides=self.lookasides)
+        cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
+
+        t1 = time.perf_counter_ns()
+        pro, trc = res.prologue_trc, res.computation_trc
+        traces = [trc]
+        for tf in self.transforms:
+            pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=None)
+            traces.append(trc)
+        trc = dce(trc)
+        traces.append(trc)
+        executors = resolve_executors(self.executors or None)
+        if self.disable_fusion:
+            executors = [e for e in executors if not e.is_fusion_executor()]
+        ex_trc = transform_for_execution(trc, executors)
+        traces.append(ex_trc)
+        for tf in self.transforms:
+            ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=None)
+            traces.append(ex_trc)
+        cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
+
+        t2 = time.perf_counter_ns()
+        entry = InterpretedEntry(pro.python_callable(), ex_trc.python_callable(), pro, ex_trc, shape_key)
+        cs.last_compile_time_ns = time.perf_counter_ns() - t2
+        cs.last_traces = traces
+        cs.last_prologue_traces = [pro]
+        self._entries.append(entry)
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        cs = self._cs
+        cs.calls += 1
+        leaves, _ = tree_flatten((args, kwargs))
+        mask = [_is_tensor_like(l) for l in leaves]
+        shape_key = self._shape_key(leaves, mask)
+        tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
+        if self.cache_option == "no caching":
+            entry = self._compile(args, kwargs, shape_key)
+            self._entries.clear()
+            return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
+        # a cache hit is the first prologue that runs without raising
+        for entry in self._entries:
+            if entry.shape_key != shape_key:
+                continue
+            try:
+                flat_inputs = entry.prologue_fn(*tensor_leaves)
+            except Exception:
+                continue
+            cs.cache_hits += 1
+            return entry.computation_fn(*flat_inputs)
+        cs.cache_misses += 1
+        entry = self._compile(args, kwargs, shape_key)
+        flat_inputs = entry.prologue_fn(*tensor_leaves)
+        return entry.computation_fn(*flat_inputs)
+
+    @property
+    def cache_hits(self):
+        return self._cs.cache_hits
+
+    @property
+    def cache_misses(self):
+        return self._cs.cache_misses

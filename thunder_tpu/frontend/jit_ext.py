@@ -1,0 +1,235 @@
+"""General jit: interpreter-based acquisition with prologue generation.
+
+Re-design of reference thunder/core/jit_ext.py:2149 (thunder_general_jit).
+Arbitrary Python callables are executed by the bytecode interpreter
+(frontend/interpreter.py); tensors captured from the environment — module
+globals, closure cells, attribute/item chains (e.g. ``self.fc1.weight`` of a
+model held in a closure) — are proxified on first load and become *prologue
+inputs*: the generated prologue trace re-extracts them with UNPACK_* prims
+and validates their metadata with CHECK_* prims on every call, so a cache
+hit is exactly "a prologue that runs without raising" (reference
+thunder/__init__.py:711-743). Captured Python scalars are baked into the
+computation as constants and guarded by value checks in the prologue
+(CONSTANT_VALUES cache semantics).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+from ..core import dtypes, prims
+from ..core.proxies import AnyProxy, Proxy, TensorProxy, proxy_from_jax
+from ..core.pytree import tree_flatten, tree_unflatten
+from ..core.trace import TraceCtx, tracectx
+from .interpreter import (
+    Interpreter,
+    InterpreterError,
+    Provenance,
+    WrappedValue,
+    unwrap,
+    wrap,
+)
+
+
+def _is_tensor_like(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, Proxy)
+
+
+def _unwrap_param(x):
+    data = getattr(x, "data", None)
+    return data if data is not None and hasattr(x, "requires_grad") else x
+
+
+def _prov_key(prov: Provenance) -> tuple:
+    return tuple((p.kind, p.key) for p in prov.chain())
+
+
+class CapturedTensor(NamedTuple):
+    proxy: TensorProxy
+    provenance: Provenance
+    value: Any  # concrete array at trace time (for metadata)
+
+
+class CapturedScalarCheck(NamedTuple):
+    provenance: Provenance
+    value: Any
+
+
+class JitResults(NamedTuple):
+    prologue_trc: TraceCtx
+    computation_trc: TraceCtx
+    captured: list
+    sharp_edges: list
+
+
+class GeneralJitCtx:
+    """Per-trace state: proxification of captured values + sharp edge log
+    (reference jit_ext.py:162 JitCtx)."""
+
+    def __init__(self, trace: TraceCtx, *, sharp_edges: str = "allow"):
+        self.trace = trace
+        self.captured: list[CapturedTensor] = []
+        self.scalar_checks: list[CapturedScalarCheck] = []
+        self._by_key: dict[tuple, Any] = {}
+        self.sharp_edges_mode = sharp_edges  # 'allow' | 'warn' | 'error'
+        self.sharp_edges: list[str] = []
+
+    def on_provenance_load(self, value: Any, prov: Provenance) -> Any:
+        if not prov.is_unpackable():
+            return value
+        root = prov.root().kind
+        if root not in ("global", "closure"):
+            return value
+        key = _prov_key(prov)
+        if key in self._by_key:
+            return self._by_key[key]
+        out = self._proxify(value, prov, depth=0)
+        if out is not value:
+            self._by_key[key] = out
+        return out
+
+    _MAX_CONTAINER_DEPTH = 3
+
+    def _proxify(self, value: Any, prov: Provenance, depth: int) -> Any:
+        raw = _unwrap_param(value)
+        if _is_tensor_like(raw):
+            key = _prov_key(prov)
+            if key in self._by_key:
+                return self._by_key[key]
+            rg = bool(getattr(value, "requires_grad", False))
+            p = proxy_from_jax(raw, requires_grad=rg)
+            self.captured.append(CapturedTensor(p, prov, raw))
+            self._by_key[key] = p
+            return p
+        if isinstance(value, (int, float, bool)) and not isinstance(value, Proxy):
+            if depth == 0:
+                # baked constant, guarded in the prologue; container entries
+                # are guarded transitively by the tensor checks around them
+                self.scalar_checks.append(CapturedScalarCheck(prov, value))
+                self._by_key[_prov_key(prov)] = value
+            return value
+        # containers: return a copy with tensor entries proxified so native
+        # iteration (for/enumerate/zip) yields proxies with item provenance
+        if depth < self._MAX_CONTAINER_DEPTH:
+            if isinstance(value, (list, tuple)):
+                items = [self._proxify(v, Provenance("item", i, prov), depth + 1)
+                         for i, v in enumerate(value)]
+                if any(a is not b for a, b in zip(items, value)):
+                    return type(value)(items)
+            elif isinstance(value, dict):
+                items = {k: self._proxify(v, Provenance("item", k, prov), depth + 1)
+                         for k, v in value.items() if isinstance(k, (str, int))}
+                if any(items.get(k) is not v for k, v in value.items()):
+                    return {**value, **items}
+        return value
+
+    def on_sharp_edge(self, msg: str) -> None:
+        self.sharp_edges.append(msg)
+        if self.sharp_edges_mode == "error":
+            raise InterpreterError(f"sharp edge: {msg}")
+        if self.sharp_edges_mode == "warn":
+            warnings.warn(f"thunder_tpu jit sharp edge: {msg}")
+
+
+def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
+                lookasides: dict | None = None) -> tuple[JitResults, Any, list, list]:
+    """Interpret fn over proxies, producing prologue + computation traces.
+
+    Returns (JitResults, treedef, tensor_mask, leaves) — same surface as
+    thunder_tpu.acquire_trace plus the prologue."""
+    leaves, treedef = tree_flatten((args, kwargs))
+    trc = TraceCtx(fn)
+    ctx = GeneralJitCtx(trc, sharp_edges=sharp_edges)
+
+    proxy_leaves = []
+    tensor_mask = []
+    with tracectx(trc):
+        for leaf in leaves:
+            if _is_tensor_like(leaf):
+                p = proxy_from_jax(leaf, requires_grad=bool(getattr(leaf, "requires_grad", False)))
+                proxy_leaves.append(p)
+                tensor_mask.append(True)
+            else:
+                proxy_leaves.append(leaf)
+                tensor_mask.append(False)
+        arg_proxies = tuple(p for p, m in zip(proxy_leaves, tensor_mask) if m)
+        pargs, pkwargs = tree_unflatten(treedef, proxy_leaves)
+
+        interp = Interpreter(lookasides=lookasides,
+                             on_provenance_load=ctx.on_provenance_load,
+                             on_sharp_edge=ctx.on_sharp_edge)
+        result = unwrap(interp.call(
+            wrap(fn),
+            [wrap(a, Provenance("arg", i)) for i, a in enumerate(pargs)],
+            {k: wrap(v, Provenance("arg", k)) for k, v in pkwargs.items()},
+        ))
+        prims.python_return(result)
+    trc.args = arg_proxies + tuple(c.proxy for c in ctx.captured)
+
+    pro = _build_prologue(fn, arg_proxies, ctx)
+    res = JitResults(pro, trc, ctx.captured, ctx.sharp_edges)
+    return res, treedef, tensor_mask, leaves
+
+
+def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: GeneralJitCtx) -> TraceCtx:
+    """Prologue trace: validate args, re-extract + validate captured values.
+
+    Signature: prologue(*tensor_args) -> (*tensor_args, *captured_tensors);
+    the root callable is interned as a constant in the generated code."""
+    pro = TraceCtx(None, prologue=True)
+    pro._name = "prologue"
+    unpack_syms = {
+        "global": prims.unpack_global,
+        "closure": prims.unpack_closure,
+        "attr": prims.unpack_attr,
+        "item": prims.unpack_item,
+    }
+    with tracectx(pro):
+        qargs = []
+        for p in arg_proxies:
+            q = TensorProxy(p.name, shape=p.shape, dtype=p.dtype, device=p.device)
+            qargs.append(q)
+            prims.check_tensor_shape_and_metadata(q, p.shape, p.dtype, str(p.device))
+        pro.args = tuple(qargs)
+
+        # emit unpack chains, sharing intermediate objects across captures
+        emitted: dict[tuple, Proxy] = {}
+
+        def emit_chain(prov: Provenance, final_proxy: Proxy | None):
+            chain = prov.chain()
+            parent: Any = fn
+            parent_proxy: Any = fn  # printed interned for the root
+            for depth, p in enumerate(chain):
+                key = tuple((q.kind, q.key) for q in chain[: depth + 1])
+                if key in emitted:
+                    parent_proxy = emitted[key]
+                    continue
+                is_last = depth == len(chain) - 1
+                out: Proxy = (final_proxy if (is_last and final_proxy is not None)
+                              else AnyProxy(name=pro.make_name("obj")))
+                sym = unpack_syms.get(p.kind)
+                if sym is None:
+                    raise InterpreterError(f"cannot build prologue for provenance {prov!r}")
+                src = parent_proxy if depth > 0 else fn
+                bsym = sym.bind(src, p.key, output=out)
+                pro.add_bound_symbol(bsym)
+                emitted[key] = out
+                parent_proxy = out
+            return parent_proxy
+
+        cap_outs = []
+        for cap in ctx.captured:
+            q = TensorProxy(cap.proxy.name, shape=cap.proxy.shape, dtype=cap.proxy.dtype,
+                            device=cap.proxy.device)
+            pro.add_name(q.name)
+            emit_chain(cap.provenance, q)
+            prims.check_tensor_shape_and_metadata(q, cap.proxy.shape, cap.proxy.dtype,
+                                                  str(cap.proxy.device))
+            cap_outs.append(q)
+
+        for chk in ctx.scalar_checks:
+            v = emit_chain(chk.provenance, None)
+            prims.check_number_type_and_value(v, type(chk.value), chk.value)
+
+        prims.python_return(tuple(qargs) + tuple(cap_outs))
+    return pro

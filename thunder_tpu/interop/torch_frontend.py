@@ -25,6 +25,7 @@ import torch
 
 from ..core import dtypes as tt_dtypes
 from ..core import prims
+from ..core.baseutils import shape_numel as _shape_numel
 from ..core.proxies import TensorProxy
 from ..core.trace import get_tracectx
 from ..ops import clang, ltorch
@@ -254,17 +255,11 @@ def _cat(tensors, dim=0):
     # initial state) is dropped when concatenated with higher-rank tensors
     max_rank = max(getattr(t, "ndim", 0) for t in ts)
     ts = [t for t in ts
-          if not (getattr(t, "ndim", 0) == 1 and _numel(t) == 0 and max_rank > 1)]
+          if not (getattr(t, "ndim", 0) == 1 and _shape_numel(getattr(t, "shape", ())) == 0
+                  and max_rank > 1)]
     if len(ts) == 1:
         return ts[0]
     return ltorch.cat(ts, dim)
-
-
-def _numel(t) -> int:
-    n = 1
-    for s in getattr(t, "shape", ()):
-        n *= int(s)
-    return n
 
 
 @_register(torch.stack)
@@ -641,13 +636,21 @@ class CompiledTorchModule:
         return self.traced.params
 
     def __call__(self, *args, **kwargs):
+        from collections.abc import Mapping
+
         def conv(x):
             if isinstance(x, torch.Tensor):
                 return torch_to_jax(x)
+            if isinstance(x, tuple) and hasattr(x, "_fields"):  # NamedTuple
+                return type(x)(*(conv(e) for e in x))
             if isinstance(x, (tuple, list)):
                 return type(x)(conv(e) for e in x)
-            if isinstance(x, dict):
-                return {k: conv(v) for k, v in x.items()}
+            if isinstance(x, Mapping):
+                items = {k: conv(v) for k, v in x.items()}
+                try:
+                    return type(x)(items)
+                except Exception:
+                    return items
             return x
 
         args = tuple(conv(a) for a in args)

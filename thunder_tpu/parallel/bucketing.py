@@ -13,18 +13,15 @@ become  pack → one all_reduce → unpack  at the site of the last one.
 """
 from __future__ import annotations
 
-import math
 from typing import Sequence
 
+from ..core.baseutils import shape_numel as _numel
+from ..core.prims import PrimIDs
 from ..core.proxies import TensorProxy, variableify
 from ..core.symbol import BoundSymbol, OpTags, Symbol
 from ..core.trace import TraceCtx, from_trace, tracectx
 from ..core.transform_common import Transform
 from ..executors.jaxex import ex as jax_ex
-
-
-def _numel(shape) -> int:
-    return int(math.prod(shape)) if shape else 1
 
 
 # ---------------------------------------------------------------------------
@@ -84,8 +81,6 @@ class GradBucketingTransform(Transform):
         consumed: dict[str, int] = {}
         ret_args: set[str] = set()
         for bsym in bsyms:
-            from ..core.prims import PrimIDs
-
             if bsym.sym.id == PrimIDs.RETURN:
                 for p in bsym.flat_proxy_args():
                     ret_args.add(p.name)
