@@ -342,7 +342,9 @@ def examine(fn, *args, **kwargs):
 
 
 def custom_op(qualname, *, like=None, meta=None, tags=()):
-    from .custom_op import custom_op as _custom_op
+    # The impl lives in `_custom_op` (underscored so importing it can never
+    # bind a submodule named `custom_op` over this function on the package).
+    from ._custom_op import custom_op as _custom_op
 
     return _custom_op(qualname, like=like, meta=meta, tags=tags)
 
