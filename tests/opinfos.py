@@ -286,5 +286,291 @@ def _ref_sdpa(q, k, v, causal=False):
     return jax.nn.softmax(scores, axis=-1) @ v
 
 
-all_opinfos = unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos + nn_opinfos
+# --- widened surface (round-1 widening: activations, pools, losses, einsum, …) ---
+
+
+def _pair_samples(rng, dt):
+    yield SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt)))
+
+
+def _nchw_samples(rng, dt):
+    yield SampleInput((make_tensor(rng, (2, 3, 8, 8), dt),))
+
+
+widened_opinfos = [
+    # unary / activations
+    _u("log10", jnp.log10, positive_unary_samples),
+    _u("lgamma", jax.lax.lgamma, positive_unary_samples, dts=F32),
+    _u("digamma", jax.lax.digamma, positive_unary_samples, dts=F32),
+    _u("square", jnp.square),
+    _u("frac", lambda x: x - jnp.trunc(x)),
+    _u("rad2deg", jnp.rad2deg),
+    _u("deg2rad", jnp.deg2rad),
+    _u("tanhshrink", lambda x: x - jnp.tanh(x)),
+    _u("softsign", jax.nn.soft_sign),
+    _u("elu", jax.nn.elu, atol=1e-4, rtol=1e-4),
+    _u("selu", jax.nn.selu, atol=1e-4, rtol=1e-4),
+    _u("celu", jax.nn.celu, atol=1e-4, rtol=1e-4),
+    _u("hardtanh", lambda x: jnp.clip(x, -1.0, 1.0)),
+    _u("hardswish", jax.nn.hard_swish, atol=1e-4, rtol=1e-4),
+    _u("hardsigmoid", jax.nn.hard_sigmoid, atol=1e-4, rtol=1e-4),
+    _u("logsigmoid", jax.nn.log_sigmoid, atol=1e-4, rtol=1e-4),
+    _u("hardshrink", lambda x: jnp.where(jnp.abs(x) > 0.5, x, 0.0)),
+    _u("softshrink", lambda x: jnp.where(x > 0.5, x - 0.5, jnp.where(x < -0.5, x + 0.5, 0.0))),
+    OpInfo(name="signbit", op=ltorch.signbit, ref=jnp.signbit,
+           sample_generator=elementwise_unary_samples, dtypes=F32_64, supports_grad=False),
+    OpInfo(name="nan_to_num", op=ltorch.nan_to_num,
+           ref=lambda x: jnp.nan_to_num(x, posinf=dtypes.finfo_max(dtypes.float32), neginf=-dtypes.finfo_max(dtypes.float32)),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf, -2.0], dtype=jnp.float32),))]),
+           dtypes=F32, supports_grad=False),
+    # binary
+    OpInfo(name="logaddexp", op=ltorch.logaddexp, ref=jnp.logaddexp, sample_generator=_pair_samples,
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="logaddexp2", op=ltorch.logaddexp2, ref=jnp.logaddexp2, sample_generator=_pair_samples,
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="hypot", op=ltorch.hypot, ref=jnp.hypot, sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="copysign", op=ltorch.copysign, ref=jnp.copysign, sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="xlogy", op=ltorch.xlogy, ref=jax.scipy.special.xlogy,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=0.1, high=3.0)))]),
+           dtypes=F32_64),
+    OpInfo(name="fmax", op=ltorch.fmax, ref=jnp.fmax, sample_generator=_pair_samples, dtypes=F32_64, supports_grad=False),
+    OpInfo(name="fmin", op=ltorch.fmin, ref=jnp.fmin, sample_generator=_pair_samples, dtypes=F32_64, supports_grad=False),
+    OpInfo(name="heaviside", op=ltorch.heaviside, ref=jnp.heaviside, sample_generator=_pair_samples,
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="clamp_min", op=ltorch.clamp_min, ref=jnp.maximum, sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="rsub", op=ltorch.rsub, ref=lambda a, b: b - a, sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="gcd", op=ltorch.gcd, ref=jnp.gcd,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray(rng.randint(1, 50, (3, 4))), jnp.asarray(rng.randint(1, 50, (3, 4)))))]),
+           dtypes=(dtypes.int32,), supports_grad=False),
+    # reductions
+    OpInfo(name="logsumexp", op=lambda a: ltorch.logsumexp(a, -1),
+           ref=lambda a: jax.scipy.special.logsumexp(a, axis=-1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 8), dt),))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="cumprod", op=lambda a: ltorch.cumprod(a, 1), ref=lambda a: jnp.cumprod(a, axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt, low=0.5, high=1.5),))]),
+           dtypes=F32_64),
+    OpInfo(name="cummax", op=lambda a: ltorch.cummax(a, 1)[0], ref=lambda a: jax.lax.cummax(a, axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),))]),
+           dtypes=F32),
+    OpInfo(name="count_nonzero", op=ltorch.count_nonzero, ref=lambda a: jnp.count_nonzero(a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="nansum", op=ltorch.nansum, ref=lambda a: jnp.nansum(a),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray([[1.0, jnp.nan], [2.0, 3.0]], dtype=jnp.float32),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="norm_2", op=lambda a: ltorch.norm(a, 2, -1), ref=lambda a: jnp.linalg.norm(a, axis=-1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 8), dt),))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="median_global", op=lambda a: ltorch.median(a),
+           ref=lambda a: jnp.sort(a.ravel())[(a.size - 1) // 2],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),))]),
+           dtypes=F32, supports_grad=False),
+    # shape
+    OpInfo(name="narrow", op=lambda a: ltorch.narrow(a, 1, 1, 3), ref=lambda a: a[:, 1:4],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]), dtypes=F32_64),
+    OpInfo(name="select", op=lambda a: ltorch.select(a, 0, 2), ref=lambda a: a[2],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]), dtypes=F32_64),
+    OpInfo(name="unbind", op=lambda a: ltorch.unbind(a, 0), ref=lambda a: tuple(a[i] for i in range(a.shape[0])),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="tile", op=lambda a: ltorch.tile(a, (2, 3)), ref=lambda a: jnp.tile(a, (2, 3)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3), dt),))]), dtypes=F32_64),
+    OpInfo(name="broadcast_to", op=lambda a: ltorch.broadcast_to(a, (4, 3, 5)),
+           ref=lambda a: jnp.broadcast_to(a, (4, 3, 5)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 1), dt),))]), dtypes=F32_64),
+    OpInfo(name="repeat_interleave", op=lambda a: ltorch.repeat_interleave(a, 3, 1),
+           ref=lambda a: jnp.repeat(a, 3, axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 4), dt),))]), dtypes=F32_64),
+    OpInfo(name="diagonal", op=lambda a: ltorch.diagonal_op(a), ref=lambda a: jnp.diagonal(a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 4), dt),))]), dtypes=F32_64),
+    OpInfo(name="diagonal_offset", op=lambda a: ltorch.diagonal_op(a, offset=1),
+           ref=lambda a: jnp.diagonal(a, offset=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]), dtypes=F32_64),
+    OpInfo(name="diag_embed", op=ltorch.diag_embed, ref=lambda a: jax.vmap(jnp.diag)(a) if a.ndim == 2 else jnp.diag(a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]), dtypes=F32),
+    OpInfo(name="meshgrid", op=lambda a, b: ltorch.meshgrid(a, b), ref=lambda a, b: tuple(jnp.meshgrid(a, b, indexing="ij")),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3,), dt), make_tensor(rng, (4,), dt)))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="ravel", op=ltorch.ravel, ref=jnp.ravel,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]), dtypes=F32_64),
+    OpInfo(name="unflatten", op=lambda a: ltorch.unflatten(a, 1, (2, 3)),
+           ref=lambda a: jnp.reshape(a, (a.shape[0], 2, 3)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 6), dt),))]), dtypes=F32_64),
+    OpInfo(name="hstack", op=lambda a, b: ltorch.hstack([a, b]), ref=lambda a, b: jnp.hstack([a, b]),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="vstack", op=lambda a, b: ltorch.vstack([a, b]), ref=lambda a, b: jnp.vstack([a, b]),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="select_scatter", op=lambda a, b: ltorch.select_scatter(a, b, 0, 1),
+           ref=lambda a, b: a.at[1].set(b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (5,), dt)))]), dtypes=F32_64),
+    OpInfo(name="slice_scatter", op=lambda a, b: ltorch.slice_scatter(a, b, 1, 1, 3),
+           ref=lambda a, b: a.at[:, 1:3].set(b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (4, 2), dt)))]), dtypes=F32_64),
+    OpInfo(name="scatter_op", op=lambda a, idx, src: ltorch.scatter(a, 1, idx, src),
+           ref=lambda a, idx, src: jnp.put_along_axis(a, idx, src, axis=1, inplace=False),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 10), dt), jnp.asarray(rng.randint(0, 10, (4, 3))),
+                            make_tensor(rng, (4, 3), dt)))]), dtypes=F32_64),
+    # factories
+    OpInfo(name="eye", op=lambda: ltorch.eye(4, 5), ref=lambda: jnp.eye(4, 5),
+           sample_generator=lambda rng, dt: iter([SampleInput(())]), dtypes=F32, supports_grad=False),
+    # matmul family
+    OpInfo(name="mm", op=ltorch.mm, ref=jnp.matmul,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (5, 6), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="mv", op=ltorch.mv, ref=jnp.matmul,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (5,), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="dot", op=ltorch.dot, ref=jnp.dot,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5,), dt), make_tensor(rng, (5,), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="outer", op=ltorch.outer, ref=jnp.outer,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4,), dt), make_tensor(rng, (5,), dt)))]), dtypes=F32_64),
+    OpInfo(name="kron", op=ltorch.kron, ref=jnp.kron,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3), dt), make_tensor(rng, (4, 5), dt)))]), dtypes=F32_64),
+    OpInfo(name="tensordot", op=lambda a, b: ltorch.tensordot(a, b, 2),
+           ref=lambda a, b: jnp.tensordot(a, b, 2),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4, 5), dt), make_tensor(rng, (4, 5, 6), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="einsum_matmul", op=lambda a, b: ltorch.einsum("ij,jk->ik", a, b),
+           ref=lambda a, b: jnp.einsum("ij,jk->ik", a, b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (5, 6), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="einsum_attn", op=lambda a, b: ltorch.einsum("bqhd,bkhd->bhqk", a, b),
+           ref=lambda a, b: jnp.einsum("bqhd,bkhd->bhqk", a, b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 4, 3, 8), dt), make_tensor(rng, (2, 5, 3, 8), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="einsum_diag", op=lambda a: ltorch.einsum("ii->i", a), ref=lambda a: jnp.einsum("ii->i", a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 4), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="cdist", op=ltorch.cdist,
+           ref=lambda a, b: jnp.sqrt(jnp.maximum(jnp.sum((a[:, None, :] - b[None, :, :]) ** 2, -1), 0)),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 8), dt), make_tensor(rng, (5, 8), dt)))]),
+           dtypes=F32_64, atol=1e-3, rtol=1e-3),
+    # pooling
+    OpInfo(name="max_pool2d", op=lambda a: ltorch.max_pool2d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, 2, 2), (1, 1, 2, 2), "VALID"),
+           sample_generator=_nchw_samples, dtypes=F32_64),
+    OpInfo(name="avg_pool2d", op=lambda a: ltorch.avg_pool2d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID") / 4.0,
+           sample_generator=_nchw_samples, dtypes=F32_64),
+    OpInfo(name="adaptive_avg_pool2d", op=lambda a: ltorch.adaptive_avg_pool2d(a, (2, 2)),
+           ref=lambda a: jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, 4, 4), (1, 1, 4, 4), "VALID") / 16.0,
+           sample_generator=_nchw_samples, dtypes=F32_64),
+    # norms
+    OpInfo(name="group_norm", op=lambda a, w, b: ltorch.group_norm(a, 2, w, b),
+           ref=lambda a, w, b: _ref_group_norm(a, 2, w, b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 4, 5), dt), make_tensor(rng, (4,), dt), make_tensor(rng, (4,), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="batch_norm_train", op=lambda a, w, b: ltorch.batch_norm(a, None, None, w, b, True),
+           ref=lambda a, w, b: _ref_batch_norm(a, w, b),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 3, 5), dt), make_tensor(rng, (3,), dt), make_tensor(rng, (3,), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="instance_norm", op=lambda a: ltorch.instance_norm(a),
+           ref=lambda a: (a - a.mean(axis=(2,), keepdims=True)) / jnp.sqrt(a.var(axis=(2,), keepdims=True) + 1e-5),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 8), dt),))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="normalize", op=lambda a: ltorch.normalize(a, 2.0, -1),
+           ref=lambda a: a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True), 1e-12),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 8), dt),))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    # resampling
+    OpInfo(name="pixel_shuffle", op=lambda a: ltorch.pixel_shuffle(a, 2),
+           ref=lambda a: _ref_pixel_shuffle(a, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 8, 3, 3), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="interpolate_nearest", op=lambda a: ltorch.interpolate(a, scale_factor=2.0, mode="nearest"),
+           ref=lambda a: jnp.repeat(jnp.repeat(a, 2, axis=2), 2, axis=3),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (1, 2, 4, 4), dt),))]),
+           dtypes=F32, supports_grad=False),
+    # distances / losses
+    OpInfo(name="cosine_similarity", op=lambda a, b: ltorch.cosine_similarity(a, b, -1),
+           ref=lambda a, b: jnp.sum(a * b, -1) / jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-8),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 8), dt), make_tensor(rng, (3, 8), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="l1_loss", op=ltorch.l1_loss, ref=lambda a, b: jnp.mean(jnp.abs(a - b)),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="smooth_l1_loss", op=ltorch.smooth_l1_loss,
+           ref=lambda a, b: jnp.mean(jnp.where(jnp.abs(a - b) < 1.0, 0.5 * (a - b) ** 2, jnp.abs(a - b) - 0.5)),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="huber_loss", op=ltorch.huber_loss,
+           ref=lambda a, b: jnp.mean(jnp.where(jnp.abs(a - b) < 1.0, 0.5 * (a - b) ** 2, jnp.abs(a - b) - 0.5)),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="bce_with_logits", op=ltorch.binary_cross_entropy_with_logits,
+           ref=lambda x, z: jnp.mean(jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), jnp.asarray(rng.randint(0, 2, (3, 4))).astype(jnp.float32)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="bce", op=ltorch.binary_cross_entropy,
+           ref=lambda p, z: jnp.mean(-(z * jnp.log(jnp.maximum(p, 1e-12)) + (1 - z) * jnp.log(jnp.maximum(1 - p, 1e-12)))),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt, low=0.05, high=0.95),
+                            jnp.asarray(rng.randint(0, 2, (3, 4))).astype(jnp.float32)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="kl_div", op=lambda a, b: ltorch.kl_div(a, b),
+           ref=lambda a, b: jnp.mean(b * (jnp.log(jnp.maximum(b, 1e-12)) - a)),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=0.05, high=0.95)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="mse_loss", op=ltorch.mse_loss, ref=lambda a, b: jnp.mean((a - b) ** 2),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    # conv_transpose
+    OpInfo(name="conv_transpose2d", op=lambda x, w: ltorch.conv_transpose2d(x, w, stride=2),
+           ref=lambda x, w: jax.lax.conv_transpose(x, w, (2, 2), "VALID",
+                                                   dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                                                   transpose_kernel=True),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 5, 5), dt), make_tensor(rng, (3, 4, 2, 2), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+]
+
+
+def _ref_group_norm(a, groups, w, b, eps=1e-5):
+    N, C = a.shape[0], a.shape[1]
+    g = a.reshape((N, groups, C // groups) + a.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    m = g.mean(axis=axes, keepdims=True)
+    v = ((g - m) ** 2).mean(axis=axes, keepdims=True)
+    out = ((g - m) / jnp.sqrt(v + eps)).reshape(a.shape)
+    view = (1, C) + (1,) * (a.ndim - 2)
+    return out * w.reshape(view) + b.reshape(view)
+
+
+def _ref_batch_norm(a, w, b, eps=1e-5):
+    axes = (0,) + tuple(range(2, a.ndim))
+    m = a.mean(axis=axes, keepdims=True)
+    v = ((a - m) ** 2).mean(axis=axes, keepdims=True)
+    out = (a - m) / jnp.sqrt(v + eps)
+    view = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+    return out * w.reshape(view) + b.reshape(view)
+
+
+def _ref_pixel_shuffle(a, r):
+    N, C, H, W = a.shape
+    out = a.reshape(N, C // (r * r), r, r, H, W)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return out.reshape(N, C // (r * r), H * r, W * r)
+
+
+all_opinfos = unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos + nn_opinfos + widened_opinfos
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]

@@ -292,3 +292,22 @@ def float_math_dtype(d) -> dtype:
     if d.is_float or d.is_complex:
         return d
     return float32
+
+
+def finfo_max(d) -> float:
+    """Largest finite value representable in dtype d (torch.finfo(d).max)."""
+    import numpy as np
+
+    d = to_dtype(d)
+    if d.is_float:
+        if d.name == "bfloat16":
+            return 3.3895313892515355e38
+        np_dt = {"float16": np.float16, "float32": np.float32, "float64": np.float64}.get(d.name, np.float32)
+        return float(np.finfo(np_dt).max)
+    return float(np.iinfo(getattr(np, d.name, np.int32)).max)
+
+
+def x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)

@@ -80,25 +80,31 @@ class PrimIDs(Enum):
     ERFINV = auto(); FLOOR = auto(); CEIL = auto(); ROUND = auto(); TRUNC = auto(); SIGN = auto()
     ISFINITE = auto(); ISNAN = auto(); ISINF = auto(); RECIPROCAL = auto(); LOGICAL_NOT = auto()
     BITWISE_NOT = auto(); REAL = auto(); IMAG = auto()
+    LOG10 = auto(); LGAMMA = auto(); DIGAMMA = auto(); SIGNBIT = auto()
     # elementwise binary
     ADD = auto(); SUB = auto(); MUL = auto(); DIV = auto(); POW = auto(); FMOD = auto()
     REMAINDER = auto(); MAXIMUM = auto(); MINIMUM = auto(); ATAN2 = auto()
     BITWISE_AND = auto(); BITWISE_OR = auto(); BITWISE_XOR = auto()
     SHIFT_LEFT = auto(); SHIFT_RIGHT = auto()
+    NEXTAFTER = auto(); COPYSIGN = auto(); HYPOT = auto(); GCD = auto(); LCM = auto()
     EQ = auto(); NE = auto(); LT = auto(); LE = auto(); GT = auto(); GE = auto()
     # ternary
     WHERE = auto()
     # reductions
     SUM = auto(); PROD = auto(); AMAX = auto(); AMIN = auto(); ARGMAX = auto(); ARGMIN = auto()
     ANY = auto(); ALL_REDUCE_BOOL = auto()
-    CUMSUM = auto()
+    CUMSUM = auto(); CUMPROD = auto(); CUMMAX = auto()
     TOPK = auto(); ARGSORT = auto(); SORT = auto()
+    REDUCE_WINDOW = auto()
     # linear algebra / NN
     MATMUL = auto()
     LINEAR = auto()
     CONVOLUTION = auto()
+    CONV_TRANSPOSE = auto()
     EMBEDDING = auto()
     GROUPED_MM = auto()
+    EINSUM = auto()
+    SCATTER = auto()
     # memory / interop
     ITEM = auto()
     COPY_WITH_SETITEM = auto()
@@ -564,10 +570,11 @@ _unary_float = [
     (PrimIDs.COSH, "cosh"), (PrimIDs.ASINH, "asinh"), (PrimIDs.ACOSH, "acosh"), (PrimIDs.ATANH, "atanh"),
     (PrimIDs.ERF, "erf"), (PrimIDs.ERFC, "erfc"), (PrimIDs.ERFINV, "erfinv"),
     (PrimIDs.RECIPROCAL, "reciprocal"),
+    (PrimIDs.LOG10, "log10"), (PrimIDs.LGAMMA, "lgamma"), (PrimIDs.DIGAMMA, "digamma"),
 ]
 _unary_bool = [
     (PrimIDs.ISFINITE, "isfinite"), (PrimIDs.ISNAN, "isnan"), (PrimIDs.ISINF, "isinf"),
-    (PrimIDs.LOGICAL_NOT, "logical_not"),
+    (PrimIDs.LOGICAL_NOT, "logical_not"), (PrimIDs.SIGNBIT, "signbit"),
 ]
 
 _g = globals()
@@ -598,6 +605,8 @@ _binary_same = [
     (PrimIDs.BITWISE_AND, "bitwise_and"), (PrimIDs.BITWISE_OR, "bitwise_or"),
     (PrimIDs.BITWISE_XOR, "bitwise_xor"), (PrimIDs.SHIFT_LEFT, "shift_left"),
     (PrimIDs.SHIFT_RIGHT, "shift_right"),
+    (PrimIDs.NEXTAFTER, "nextafter"), (PrimIDs.COPYSIGN, "copysign"), (PrimIDs.HYPOT, "hypot"),
+    (PrimIDs.GCD, "gcd"), (PrimIDs.LCM, "lcm"),
 ]
 for pid, name in _binary_same:
     _g[name] = make_prim(pid, name, lambda a, b: _same_shape_meta(a, b), tags=(OpTags.ELEMENTWISE,))
@@ -669,6 +678,33 @@ def _cumsum_meta(a, dim):
 
 
 cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", _cumsum_meta)
+cumprod = make_prim(PrimIDs.CUMPROD, "cumprod", _cumsum_meta)
+
+
+def _cummax_meta(a, dim):
+    values = TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+    indices = TensorProxy(shape=a.shape, dtype=dtypes.int32, device=a.device)
+    return values, indices
+
+
+cummax = make_prim(PrimIDs.CUMMAX, "cummax", _cummax_meta)
+
+
+def _reduce_window_meta(a, window_dims, strides, padding, *, op="max"):
+    """Pooling workhorse (lowered to jax.lax.reduce_window → XLA ReduceWindow).
+
+    Reference analog: torch max_pool/avg_pool routed through ATen
+    (thunder/torch/default_torch_ops.py); on TPU ReduceWindow is the native
+    pooling form so it is a first-class prim here.
+    padding: per-dim (lo, hi) pairs."""
+    check(op in ("max", "sum", "min"), lambda: f"reduce_window op {op}")
+    shape = []
+    for s, w, st, (lo, hi) in zip(a.shape, window_dims, strides, padding):
+        shape.append((s + int(pyval(lo)) + int(pyval(hi)) - int(pyval(w))) // int(pyval(st)) + 1)
+    return TensorProxy(shape=tuple(shape), dtype=a.dtype, device=a.device)
+
+
+reduce_window = make_prim(PrimIDs.REDUCE_WINDOW, "reduce_window", _reduce_window_meta, tags=(OpTags.REDUCTION_OP,))
 
 
 def _topk_meta(a, k, dim):
@@ -759,6 +795,24 @@ def _convolution_meta(a, weight, bias, stride, padding, dilation, groups):
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", _convolution_meta, tags=(OpTags.MATMUL_OP,))
 
 
+def _conv_transpose_meta(a, weight, bias, stride, padding, output_padding, dilation, groups):
+    # a: (N, Cin, *spatial), weight: (Cin, Cout/groups, *kernel) — torch layout
+    n_spatial = a.ndim - 2
+    stride = tuple(pyval(s) for s in stride)
+    padding = tuple(pyval(p) for p in padding)
+    output_padding = tuple(pyval(p) for p in output_padding)
+    dilation = tuple(pyval(d) for d in dilation)
+    out_spatial = []
+    for i in range(n_spatial):
+        k_eff = (weight.shape[2 + i] - 1) * dilation[i] + 1
+        out_spatial.append((a.shape[2 + i] - 1) * stride[i] - 2 * padding[i] + k_eff + output_padding[i])
+    shape = (a.shape[0], weight.shape[1] * groups, *out_spatial)
+    return TensorProxy(shape=shape, dtype=a.dtype, device=a.device)
+
+
+conv_transpose = make_prim(PrimIDs.CONV_TRANSPOSE, "conv_transpose", _conv_transpose_meta, tags=(OpTags.MATMUL_OP,))
+
+
 def _embedding_meta(indices, weight):
     shape = indices.shape + (weight.shape[1],)
     return TensorProxy(shape=shape, dtype=weight.dtype, device=weight.device)
@@ -778,6 +832,25 @@ def _grouped_mm_meta(a, b, group_sizes):
 
 
 grouped_mm = make_prim(PrimIDs.GROUPED_MM, "grouped_mm", _grouped_mm_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _einsum_meta(spec, *operands):
+    from .einsum_utils import output_shape
+
+    spec = pyval(spec)
+    shape = output_shape(spec, [op.shape for op in operands])
+    return TensorProxy(shape=shape, dtype=operands[0].dtype, device=operands[0].device)
+
+
+einsum = make_prim(PrimIDs.EINSUM, "einsum", _einsum_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _scatter_meta(a, indices, value, dim):
+    """put_along_axis-style scatter (torch.scatter with src tensor)."""
+    return TensorProxy(shape=a.shape, dtype=a.dtype, device=a.device)
+
+
+scatter = make_prim(PrimIDs.SCATTER, "scatter", _scatter_meta)
 
 
 # ---------------------------------------------------------------------------

@@ -162,6 +162,8 @@ _unary_impls = {
     PrimIDs.ISFINITE: jnp.isfinite, PrimIDs.ISNAN: jnp.isnan, PrimIDs.ISINF: jnp.isinf,
     PrimIDs.RECIPROCAL: jnp.reciprocal, PrimIDs.LOGICAL_NOT: jnp.logical_not,
     PrimIDs.BITWISE_NOT: jnp.invert, PrimIDs.REAL: jnp.real, PrimIDs.IMAG: jnp.imag,
+    PrimIDs.LOG10: jnp.log10, PrimIDs.LGAMMA: lax.lgamma, PrimIDs.DIGAMMA: lax.digamma,
+    PrimIDs.SIGNBIT: jnp.signbit,
 }
 for pid, fn in _unary_impls.items():
     _reg(pid, fn)
@@ -191,6 +193,8 @@ _binary_impls = {
     PrimIDs.SHIFT_LEFT: jnp.left_shift, PrimIDs.SHIFT_RIGHT: jnp.right_shift,
     PrimIDs.EQ: jnp.equal, PrimIDs.NE: jnp.not_equal, PrimIDs.LT: jnp.less,
     PrimIDs.LE: jnp.less_equal, PrimIDs.GT: jnp.greater, PrimIDs.GE: jnp.greater_equal,
+    PrimIDs.NEXTAFTER: jnp.nextafter, PrimIDs.COPYSIGN: jnp.copysign, PrimIDs.HYPOT: jnp.hypot,
+    PrimIDs.GCD: jnp.gcd, PrimIDs.LCM: jnp.lcm,
 }
 for pid, fn in _binary_impls.items():
     _reg(pid, fn)
@@ -214,6 +218,46 @@ _reg(PrimIDs.ARGMAX, lambda a, dim: jnp.argmax(a, axis=dim).astype(_jd(dtypes.in
 _reg(PrimIDs.ARGMIN, lambda a, dim: jnp.argmin(a, axis=dim).astype(_jd(dtypes.int64)))
 _reg(PrimIDs.ANY, lambda a, dims: jnp.any(a, axis=dims))
 _reg(PrimIDs.CUMSUM, lambda a, dim: jnp.cumsum(a, axis=dim))
+_reg(PrimIDs.CUMPROD, lambda a, dim: jnp.cumprod(a, axis=dim))
+
+
+def _cummax(a, dim):
+    # joint (value, index) scan so indices stay correct through NaNs and ties
+    # (torch: NaN propagates and carries its position; ties keep the latest).
+    dim = dim % a.ndim
+    idx = jnp.arange(a.shape[dim], dtype=jnp.int32)
+    idx = jnp.broadcast_to(idx.reshape((-1,) + (1,) * (a.ndim - 1 - dim)), a.shape)
+    is_float = jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+
+    def combine(x, y):
+        xv, xi = x
+        yv, yi = y
+        take_y = yv >= xv
+        if is_float:
+            # NaN absorbs (a NaN on the right always wins, incl. over an
+            # earlier NaN); a non-NaN right never beats a NaN left
+            take_y = jnp.logical_or(jnp.isnan(yv), jnp.logical_and(take_y, ~jnp.isnan(xv)))
+        return jnp.where(take_y, yv, xv), jnp.where(take_y, yi, xi)
+
+    values, indices = lax.associative_scan(combine, (a, idx), axis=dim)
+    return values, indices
+
+
+_reg(PrimIDs.CUMMAX, _cummax)
+
+
+def _reduce_window(a, window_dims, strides, padding, *, op="max"):
+    init, fn = {
+        "max": (-jnp.inf if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.iinfo(jnp.asarray(a).dtype).min, lax.max),
+        "min": (jnp.inf if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.iinfo(jnp.asarray(a).dtype).max, lax.min),
+        "sum": (0, lax.add),
+    }[op]
+    init = jnp.asarray(init, jnp.asarray(a).dtype)
+    return lax.reduce_window(a, init, fn, tuple(int(w) for w in window_dims),
+                             tuple(int(s) for s in strides), tuple((int(l), int(h)) for l, h in padding))
+
+
+_reg(PrimIDs.REDUCE_WINDOW, _reduce_window)
 _reg(PrimIDs.TOPK, lambda a, k, dim: _topk(a, k, dim))
 
 
@@ -280,7 +324,60 @@ def _convolution(a, weight, bias, stride, padding, dilation, groups):
 
 
 _reg(PrimIDs.CONVOLUTION, _convolution)
+
+
+def _conv_transpose(a, weight, bias, stride, padding, output_padding, dilation, groups):
+    # torch layout: a (N, Cin, *S), weight (Cin, Cout/groups, *K).
+    # Implemented as the gradient of a forward conv (lhs-dilated conv), which
+    # matches torch.nn.functional.conv_transpose semantics exactly.
+    n_spatial = a.ndim - 2
+    dim_chars = "DHW"[-n_spatial:]
+    lhs_spec = "NC" + dim_chars
+    rhs_spec = "IO" + dim_chars
+    k_eff = [(weight.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n_spatial)]
+    pads = tuple(
+        (k_eff[i] - 1 - padding[i], k_eff[i] - 1 - padding[i] + output_padding[i])
+        for i in range(n_spatial)
+    )
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n_spatial)))
+    if groups > 1:
+        # regroup (Cin, Cout/g, *K) -> feature groups over output channels
+        cin, coutg = w.shape[0], w.shape[1]
+        w = w.reshape((groups, cin // groups, coutg) + w.shape[2:])
+        w = jnp.moveaxis(w, 2, 1).reshape((groups * coutg, cin // groups) + w.shape[3:])
+        rhs_spec = "OI" + dim_chars
+    out = lax.conv_general_dilated(
+        a, w,
+        window_strides=(1,) * n_spatial,
+        padding=pads,
+        lhs_dilation=tuple(stride),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+        feature_group_count=groups,
+        preferred_element_type=_preferred_acc(a),
+    ).astype(jnp.asarray(a).dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n_spatial)
+    return out
+
+
+_reg(PrimIDs.CONV_TRANSPOSE, _conv_transpose)
 _reg(PrimIDs.EMBEDDING, lambda indices, weight: jnp.take(weight, indices, axis=0))
+
+
+def _einsum_impl(spec, *operands):
+    return jnp.einsum(spec, *operands, preferred_element_type=_preferred_acc(operands[0])).astype(
+        jnp.asarray(operands[0]).dtype)
+
+
+_reg(PrimIDs.EINSUM, _einsum_impl)
+
+
+def _scatter(a, indices, value, dim):
+    return jnp.put_along_axis(a, indices, value, axis=dim, inplace=False)
+
+
+_reg(PrimIDs.SCATTER, _scatter)
 
 
 def _grouped_mm(a, b, group_sizes):

@@ -320,6 +320,9 @@ def unsqueeze(a: TensorProxy, dim: int) -> TensorProxy:
 def squeeze(a: TensorProxy, dim=None) -> TensorProxy:
     if dim is None:
         dims = tuple(i for i, s in enumerate(a.shape) if s == 1)
+    elif isinstance(dim, (tuple, list)):
+        dims = tuple(canonicalize_dim(a.ndim, pyval(d)) for d in dim)
+        dims = tuple(d for d in dims if a.shape[d] == 1)
     else:
         dims = (canonicalize_dim(a.ndim, pyval(dim)),)
         if a.shape[dims[0]] != 1:

@@ -904,3 +904,1331 @@ def glu(a, dim=-1):
 @torchsymbol(name="swiglu", id="thunder_tpu.swiglu")
 def swiglu(gate, up):
     return clang.mul(clang.mul(gate, clang.true_divide(1.0, clang.add(1.0, prims.exp(prims.neg(gate))))), up)
+
+
+# ---------------------------------------------------------------------------
+# widened op surface (reference thunder/torch/__init__.py has ~345 symbols;
+# everything below decomposes into the prim set so autodiff + fusion follow)
+# ---------------------------------------------------------------------------
+
+log10 = _unary("log10", prims.log10, int_to_float=True)
+lgamma = _unary("lgamma", prims.lgamma, int_to_float=True)
+digamma = _unary("digamma", prims.digamma, int_to_float=True)
+erfinv = _unary("erfinv", prims.erfinv, int_to_float=True)
+asinh = _unary("asinh", prims.asinh, int_to_float=True)
+acosh = _unary("acosh", prims.acosh, int_to_float=True)
+atanh = _unary("atanh", prims.atanh, int_to_float=True)
+signbit = _unary("signbit", prims.signbit)
+
+
+@torchsymbol(name="square", method_names=("square",))
+def square(a):
+    return clang.mul(a, a)
+
+
+@torchsymbol(name="frac", method_names=("frac",))
+def frac(a):
+    return clang.sub(a, prims.trunc(a))
+
+
+@torchsymbol(name="positive", method_names=("positive",))
+def positive(a):
+    return a
+
+
+@torchsymbol(name="rad2deg", method_names=("rad2deg",))
+def rad2deg(a):
+    return clang.mul(a, 180.0 / math.pi)
+
+
+@torchsymbol(name="deg2rad", method_names=("deg2rad",))
+def deg2rad(a):
+    return clang.mul(a, math.pi / 180.0)
+
+
+@torchsymbol(name="logit")
+def logit(a, eps=None):
+    if eps is not None:
+        a = clang.minimum(clang.maximum(a, eps), 1.0 - eps)
+    return prims.log(clang.true_divide(a, clang.sub(1.0, a)))
+
+
+@torchsymbol(name="nan_to_num", method_names=("nan_to_num",))
+def nan_to_num(a, nan=0.0, posinf=None, neginf=None):
+    if posinf is None:
+        posinf = dtypes.finfo_max(a.dtype)
+    if neginf is None:
+        neginf = -dtypes.finfo_max(a.dtype)
+    out = clang.where(prims.isnan(a), clang.full_like(a, nan), a)
+    out = clang.where(clang.eq(a, float("inf")), clang.full_like(a, posinf), out)
+    out = clang.where(clang.eq(a, float("-inf")), clang.full_like(a, neginf), out)
+    return out
+
+
+# activation family ----------------------------------------------------------
+
+
+@torchsymbol(name="hardtanh", id="torch.nn.functional.hardtanh")
+def hardtanh(a, min_val=-1.0, max_val=1.0):
+    return clang.minimum(clang.maximum(a, min_val), max_val)
+
+
+@torchsymbol(name="hardswish", id="torch.nn.functional.hardswish")
+def hardswish(a):
+    return clang.mul(a, clang.true_divide(clang.minimum(clang.maximum(clang.add(a, 3.0), 0.0), 6.0), 6.0))
+
+
+@torchsymbol(name="hardsigmoid", id="torch.nn.functional.hardsigmoid")
+def hardsigmoid(a):
+    return clang.true_divide(clang.minimum(clang.maximum(clang.add(a, 3.0), 0.0), 6.0), 6.0)
+
+
+@torchsymbol(name="hardshrink", id="torch.nn.functional.hardshrink")
+def hardshrink(a, lambd=0.5):
+    keep = clang.logical_or(clang.gt(a, lambd), clang.lt(a, -lambd))
+    return clang.where(keep, a, clang.full_like(a, 0))
+
+
+@torchsymbol(name="softshrink", id="torch.nn.functional.softshrink")
+def softshrink(a, lambd=0.5):
+    pos = clang.gt(a, lambd)
+    neg = clang.lt(a, -lambd)
+    out = clang.where(pos, clang.sub(a, lambd), clang.full_like(a, 0))
+    return clang.where(neg, clang.add(a, lambd), out)
+
+
+@torchsymbol(name="tanhshrink", id="torch.nn.functional.tanhshrink")
+def tanhshrink(a):
+    return clang.sub(a, prims.tanh(a))
+
+
+@torchsymbol(name="softsign", id="torch.nn.functional.softsign")
+def softsign(a):
+    return clang.true_divide(a, clang.add(1.0, prims.abs(a)))
+
+
+@torchsymbol(name="elu", id="torch.nn.functional.elu")
+def elu(a, alpha=1.0):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, prims.expm1(a)))
+
+
+@torchsymbol(name="selu", id="torch.nn.functional.selu")
+def selu(a):
+    _alpha = 1.6732632423543772848170429916717
+    _scale = 1.0507009873554804934193349852946
+    return clang.mul(_scale, clang.where(clang.gt(a, 0), a, clang.mul(_alpha, prims.expm1(a))))
+
+
+@torchsymbol(name="celu", id="torch.nn.functional.celu")
+def celu(a, alpha=1.0):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, prims.expm1(clang.true_divide(a, alpha))))
+
+
+@torchsymbol(name="prelu", id="torch.nn.functional.prelu")
+def prelu(a, weight):
+    if weight.numel != 1 and a.ndim > 1:
+        weight = clang.reshape(weight, (1, weight.shape[0]) + (1,) * (a.ndim - 2))
+    return clang.where(clang.gt(a, 0), a, clang.mul(a, weight))
+
+
+@torchsymbol(name="logsigmoid", id="torch.nn.functional.logsigmoid")
+def logsigmoid(a):
+    # numerically stable: -softplus(-x)
+    neg = prims.neg(a)
+    return prims.neg(clang.where(clang.gt(neg, 20.0), neg, prims.log1p(prims.exp(neg))))
+
+
+@torchsymbol(name="threshold", id="torch.nn.functional.threshold")
+def threshold(a, threshold_value, value):
+    return clang.where(clang.gt(a, threshold_value), a, clang.full_like(a, pyval(value)))
+
+
+# binary family --------------------------------------------------------------
+
+
+@torchsymbol(name="logaddexp", method_names=("logaddexp",))
+def logaddexp(a, b):
+    m = clang.maximum(a, b)
+    out = clang.add(m, prims.log1p(prims.exp(prims.neg(prims.abs(clang.sub(a, b))))))
+    # a == b (incl. ±inf where a-b is nan): exact result is a + log(2)
+    return clang.where(clang.eq(a, b), clang.add(m, math.log(2.0)), out)
+
+
+@torchsymbol(name="logaddexp2", method_names=("logaddexp2",))
+def logaddexp2(a, b):
+    m = clang.maximum(a, b)
+    inner = prims.exp2(prims.neg(prims.abs(clang.sub(a, b))))
+    out = clang.add(m, clang.true_divide(prims.log1p(inner), math.log(2.0)))
+    return clang.where(clang.eq(a, b), clang.add(m, 1.0), out)
+
+
+@torchsymbol(name="hypot", method_names=("hypot",))
+def hypot(a, b):
+    return clang._elementwise_binary(prims.hypot, a, b)
+
+
+@torchsymbol(name="copysign", method_names=("copysign",))
+def copysign(a, b):
+    return clang._elementwise_binary(prims.copysign, a, b)
+
+
+@torchsymbol(name="nextafter", method_names=("nextafter",))
+def nextafter(a, b):
+    return clang._elementwise_binary(prims.nextafter, a, b)
+
+
+@torchsymbol(name="gcd", method_names=("gcd",))
+def gcd(a, b):
+    return clang._elementwise_binary(prims.gcd, a, b)
+
+
+@torchsymbol(name="lcm", method_names=("lcm",))
+def lcm(a, b):
+    return clang._elementwise_binary(prims.lcm, a, b)
+
+
+@torchsymbol(name="xlogy", method_names=("xlogy",))
+def xlogy(a, b):
+    safe = prims.log(clang.where(clang.eq(a, 0), clang.full_like(b, 1.0), b))
+    return clang.where(clang.eq(a, 0), clang.full_like(safe, 0.0), clang.mul(a, safe))
+
+
+@torchsymbol(name="float_power", method_names=("float_power",))
+def float_power(a, b):
+    a = clang.maybe_convert_to_dtype(a, dtypes.float64 if dtypes.x64_enabled() else dtypes.float32)
+    return clang.pow_(a, b)
+
+
+@torchsymbol(name="fmax", method_names=("fmax",))
+def fmax(a, b):
+    both = clang.maximum(a, b)
+    return clang.where(prims.isnan(clang.ensure_proxy(a) if not isinstance(a, TensorProxy) else a), b, clang.where(prims.isnan(clang.ensure_proxy(b) if not isinstance(b, TensorProxy) else b), a, both))
+
+
+@torchsymbol(name="fmin", method_names=("fmin",))
+def fmin(a, b):
+    both = clang.minimum(a, b)
+    return clang.where(prims.isnan(clang.ensure_proxy(a) if not isinstance(a, TensorProxy) else a), b, clang.where(prims.isnan(clang.ensure_proxy(b) if not isinstance(b, TensorProxy) else b), a, both))
+
+
+@torchsymbol(name="heaviside", method_names=("heaviside",))
+def heaviside(a, values):
+    out = clang.where(clang.gt(a, 0), clang.full_like(a, 1.0), clang.full_like(a, 0.0))
+    return clang.where(clang.eq(a, 0), values, out)
+
+
+@torchsymbol(name="clamp_min", method_names=("clamp_min",))
+def clamp_min(a, min):
+    return clang.maximum(a, min)
+
+
+@torchsymbol(name="clamp_max", method_names=("clamp_max",))
+def clamp_max(a, max):
+    return clang.minimum(a, max)
+
+
+@torchsymbol(name="rsub", method_names=("rsub",))
+def rsub(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        a = clang.mul(a, alpha)
+    return clang.sub(b, a)
+
+
+@torchsymbol(name="logical_xor", method_names=("logical_xor",))
+def logical_xor(a, b):
+    return clang.ne(clang.to_bool(a), clang.to_bool(b))
+
+
+@torchsymbol(name="bitwise_left_shift", method_names=("bitwise_left_shift",))
+def bitwise_left_shift(a, b):
+    return clang._elementwise_binary(prims.shift_left, a, b)
+
+
+@torchsymbol(name="bitwise_right_shift", method_names=("bitwise_right_shift",))
+def bitwise_right_shift(a, b):
+    return clang._elementwise_binary(prims.shift_right, a, b)
+
+
+# reductions (widened) -------------------------------------------------------
+
+
+@torchsymbol(name="logsumexp", method_names=("logsumexp",))
+def logsumexp(a, dim, keepdim=False):
+    m = clang.amax(a, dim, keepdim=True)
+    m_stopped = prims.stop_gradient(m)
+    s = clang.sum_(prims.exp(clang.sub(a, m_stopped)), dim, keepdim=True)
+    out = clang.add(prims.log(s), m_stopped)
+    if not keepdim:
+        dims = clang._reduction_dims(a, dim)
+        out = clang.squeeze(out, dims)
+    return out
+
+
+@torchsymbol(name="softmin", id="torch.nn.functional.softmin")
+def softmin(a, dim=-1):
+    return softmax.meta(prims.neg(a), dim)
+
+
+@torchsymbol(name="cumprod", method_names=("cumprod",))
+def cumprod(a, dim):
+    return prims.cumprod(a, canonicalize_dim(a.ndim, pyval(dim)))
+
+
+@torchsymbol(name="cummax", method_names=("cummax",))
+def cummax(a, dim):
+    return prims.cummax(a, canonicalize_dim(a.ndim, pyval(dim)))
+
+
+@torchsymbol(name="count_nonzero", method_names=("count_nonzero",))
+def count_nonzero(a, dim=None):
+    nz = clang.ne(a, 0)
+    return clang.sum_(clang.maybe_convert_to_dtype(nz, dtypes.int64), dim, False)
+
+
+@torchsymbol(name="nansum", method_names=("nansum",))
+def nansum(a, dim=None, keepdim=False):
+    cleaned = clang.where(prims.isnan(a), clang.full_like(a, 0), a)
+    return clang.sum_(cleaned, dim, keepdim)
+
+
+@torchsymbol(name="nanmean", method_names=("nanmean",))
+def nanmean(a, dim=None, keepdim=False):
+    nan_mask = prims.isnan(a)
+    cleaned = clang.where(nan_mask, clang.full_like(a, 0), a)
+    total = clang.sum_(cleaned, dim, keepdim)
+    count = clang.sum_(clang.maybe_convert_to_dtype(prims.logical_not(nan_mask), a.dtype), dim, keepdim)
+    return clang.true_divide(total, count)
+
+
+@torchsymbol(name="aminmax", method_names=("aminmax",))
+def aminmax(a, *, dim=None, keepdim=False):
+    return clang.amin(a, dim, keepdim), clang.amax(a, dim, keepdim)
+
+
+@torchsymbol(name="std_mean")
+def std_mean(a, dim=None, keepdim=False, *, correction=1):
+    v, m = clang.var_mean(a, dim, keepdim, correction=correction)
+    return prims.sqrt(v), m
+
+
+@torchsymbol(name="median", method_names=("median",))
+def median(a, dim=None, keepdim=False):
+    """torch.median: global form returns the lower median value."""
+    if dim is None:
+        flat = clang.reshape(a, (a.numel,))
+        s = prims.sort(flat, 0, False)
+        return clang.squeeze(clang.slice_in_dim(s, (a.numel - 1) // 2, (a.numel - 1) // 2 + 1, 0), (0,))
+    d = canonicalize_dim(a.ndim, pyval(dim))
+    n = a.shape[d]
+    sv = prims.sort(a, d, False)
+    si = prims.argsort(a, d, False)
+    values = clang.slice_in_dim(sv, (n - 1) // 2, (n - 1) // 2 + 1, d)
+    indices = clang.slice_in_dim(si, (n - 1) // 2, (n - 1) // 2 + 1, d)
+    if not keepdim:
+        values = clang.squeeze(values, (d,))
+        indices = clang.squeeze(indices, (d,))
+    return values, clang.maybe_convert_to_dtype(indices, dtypes.int64)
+
+
+@torchsymbol(name="norm", method_names=("norm",))
+def norm(a, p=2, dim=None, keepdim=False):
+    p = pyval(p) if not isinstance(p, str) else p
+    if p == "fro" or p == 2:
+        return prims.sqrt(clang.sum_(clang.mul(a, a), dim, keepdim))
+    if p == "inf" or p == float("inf"):
+        return clang.amax(prims.abs(a), dim, keepdim)
+    if p == float("-inf"):
+        return clang.amin(prims.abs(a), dim, keepdim)
+    if p == 1:
+        return clang.sum_(prims.abs(a), dim, keepdim)
+    powd = clang.pow_(prims.abs(a), p)
+    return clang.pow_(clang.sum_(powd, dim, keepdim), 1.0 / p)
+
+
+@torchsymbol(name="vector_norm", id="torch.linalg.vector_norm")
+def vector_norm(a, ord=2, dim=None, keepdim=False):
+    return norm.meta(a, ord, dim, keepdim)
+
+
+# shape ops (widened) --------------------------------------------------------
+
+
+@torchsymbol(name="narrow", method_names=("narrow",))
+def narrow(a, dim, start, length):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    start = pyval(start)
+    if start < 0:
+        start += a.shape[dim]
+    return clang.slice_in_dim(a, start, start + pyval(length), dim)
+
+
+@torchsymbol(name="select", method_names=("select",))
+def select(a, dim, index):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    index = pyval(index)
+    if index < 0:
+        index += a.shape[dim]
+    return clang.squeeze(clang.slice_in_dim(a, index, index + 1, dim), (dim,))
+
+
+@torchsymbol(name="unbind", method_names=("unbind",))
+def unbind(a, dim=0):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    return tuple(select.meta(a, dim, i) for i in builtins.range(a.shape[dim]))
+
+
+@torchsymbol(name="split_with_sizes", method_names=("split_with_sizes",))
+def split_with_sizes(a, split_sizes, dim=0):
+    return clang.split(a, [pyval(s) for s in split_sizes], pyval(dim))
+
+
+@torchsymbol(name="hsplit", method_names=("hsplit",))
+def hsplit(a, indices_or_sections):
+    d = 0 if a.ndim == 1 else 1
+    return _split_by(a, indices_or_sections, d)
+
+
+@torchsymbol(name="vsplit", method_names=("vsplit",))
+def vsplit(a, indices_or_sections):
+    return _split_by(a, indices_or_sections, 0)
+
+
+def _split_by(a, indices_or_sections, dim):
+    n = a.shape[dim]
+    if isinstance(indices_or_sections, int):
+        check(n % indices_or_sections == 0, lambda: f"split {n} into {indices_or_sections}")
+        return clang.split(a, n // indices_or_sections, dim)
+    pts = [pyval(p) for p in indices_or_sections]
+    sizes, prev = [], 0
+    for p in pts:
+        sizes.append(p - prev)
+        prev = p
+    sizes.append(n - prev)
+    return clang.split(a, sizes, dim)
+
+
+@torchsymbol(name="tensor_split", method_names=("tensor_split",))
+def tensor_split(a, indices_or_sections, dim=0):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    n = a.shape[dim]
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        base, rem = divmod(n, k)
+        sizes = [base + (1 if i < rem else 0) for i in builtins.range(k)]
+        return clang.split(a, sizes, dim)
+    return _split_by(a, indices_or_sections, dim)
+
+
+@torchsymbol(name="tile", method_names=("tile",))
+def tile(a, *dims):
+    if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+        dims = tuple(dims[0])
+    out = a
+    while out.ndim < len(dims):
+        out = clang.unsqueeze(out, 0)
+    dims = (1,) * (out.ndim - len(dims)) + tuple(pyval(d) for d in dims)
+    for i, d in enumerate(dims):
+        if d > 1:
+            out = clang.cat([out] * d, i)
+    return out
+
+
+@torchsymbol(name="broadcast_to", method_names=("broadcast_to",))
+def broadcast_to(a, shape):
+    return clang.expand(a, tuple(shape))
+
+
+@torchsymbol(name="expand_as", method_names=("expand_as",))
+def expand_as(a, other):
+    return clang.expand(a, other.shape)
+
+
+@torchsymbol(name="repeat_interleave", method_names=("repeat_interleave",))
+def repeat_interleave(a, repeats, dim=None):
+    check(isinstance(repeats, (int, NumberProxy)), lambda: "repeat_interleave: only int repeats supported (static shapes)")
+    r = pyval(repeats)
+    if dim is None:
+        a = clang.reshape(a, (a.numel,))
+        d = 0
+    else:
+        d = canonicalize_dim(a.ndim, pyval(dim))
+    expanded = clang.unsqueeze(a, d + 1)
+    tiled = clang.cat([expanded] * r, d + 1)
+    new_shape = tuple(s * r if i == d else s for i, s in enumerate(a.shape))
+    return clang.reshape(tiled, new_shape)
+
+
+@torchsymbol(name="diag", method_names=("diag",))
+def diag(a, diagonal=0):
+    k = pyval(diagonal)
+    if a.ndim == 1:
+        n = a.shape[0] + builtins.abs(k)
+        r = clang.unsqueeze(prims.iota(n, dtype=dtypes.int32, device=a.device), 1)
+        c = clang.unsqueeze(prims.iota(n, dtype=dtypes.int32, device=a.device), 0)
+        mask = clang.eq(clang.sub(c, r), k)
+        # place values: index vector along the diagonal
+        src = clang.expand(clang.unsqueeze(a, 0), (n, a.shape[0]))
+        idx = clang.sub(c if k >= 0 else r, builtins.abs(k))
+        take_idx = clang.maximum(clang.minimum(idx, a.shape[0] - 1), 0)
+        vals = clang.take_along_axis(src, clang.expand(take_idx, (n, n)) if take_idx.shape != (n, n) else take_idx, 1)
+        zero = clang.full_like(vals, 0)
+        return clang.where(mask, vals, zero)
+    return diagonal_op.meta(a, offset=k)
+
+
+@torchsymbol(name="diagonal", method_names=("diagonal",), id="torch.diagonal")
+def diagonal_op(a, offset=0, dim1=0, dim2=1):
+    d1 = canonicalize_dim(a.ndim, pyval(dim1))
+    d2 = canonicalize_dim(a.ndim, pyval(dim2))
+    k = pyval(offset)
+    n1, n2 = a.shape[d1], a.shape[d2]
+    dlen = builtins.max(0, builtins.min(n1, n2 - k) if k >= 0 else builtins.min(n1 + k, n2))
+    # move d1,d2 to the end
+    order = [i for i in builtins.range(a.ndim) if i not in (d1, d2)] + [d1, d2]
+    moved = clang.permute(a, order)
+    i = prims.iota(dlen, dtype=dtypes.int32, device=a.device)
+    r = clang.add(i, builtins.max(0, -k))
+    c = clang.add(i, builtins.max(0, k))
+    flat = clang.reshape(moved, moved.shape[:-2] + (n1 * n2,))
+    lin = clang.add(clang.mul(r, n2), c)
+    lin_b = clang.expand_to(lin, flat.shape[:-1] + (dlen,))
+    return clang.take_along_axis(flat, lin_b, flat.ndim - 1)
+
+
+@torchsymbol(name="diag_embed", method_names=("diag_embed",))
+def diag_embed(a, offset=0):
+    k = pyval(offset)
+    m = a.shape[-1]
+    n = m + builtins.abs(k)
+    r = clang.unsqueeze(prims.iota(n, dtype=dtypes.int32, device=a.device), 1)
+    c = clang.unsqueeze(prims.iota(n, dtype=dtypes.int32, device=a.device), 0)
+    mask = clang.eq(clang.sub(c, r), k)
+    idx = clang.maximum(clang.minimum(clang.sub(r if k >= 0 else c, 0), m - 1), 0)
+    idx_flat = clang.reshape(clang.expand(idx, (n, n)) if idx.shape != (n, n) else idx, (n * n,))
+    gathered = clang.take(a, idx_flat, a.ndim - 1)
+    gathered = clang.reshape(gathered, a.shape[:-1] + (n, n))
+    mask_b = clang.expand_to(mask, gathered.shape)
+    return clang.where(mask_b, gathered, clang.full_like(gathered, 0))
+
+
+@torchsymbol(name="meshgrid")
+def meshgrid(*tensors, indexing="ij"):
+    tensors = list(tensors[0]) if len(tensors) == 1 and isinstance(tensors[0], (tuple, list)) else list(tensors)
+    n = len(tensors)
+    shape = tuple(t.shape[0] for t in tensors)
+    outs = []
+    for i, t in enumerate(tensors):
+        view = [1] * n
+        view[i] = t.shape[0]
+        out = clang.expand(clang.reshape(t, tuple(view)), shape)
+        outs.append(out)
+    if indexing == "xy" and n >= 2:
+        outs = [clang.transpose(o, 0, 1) for o in outs]
+    return tuple(outs)
+
+
+@torchsymbol(name="atleast_1d")
+def atleast_1d(a):
+    return a if a.ndim >= 1 else clang.reshape(a, (1,))
+
+
+@torchsymbol(name="atleast_2d")
+def atleast_2d(a):
+    if a.ndim >= 2:
+        return a
+    if a.ndim == 1:
+        return clang.unsqueeze(a, 0)
+    return clang.reshape(a, (1, 1))
+
+
+@torchsymbol(name="atleast_3d")
+def atleast_3d(a):
+    if a.ndim >= 3:
+        return a
+    if a.ndim == 2:
+        return clang.unsqueeze(a, 2)
+    if a.ndim == 1:
+        return clang.reshape(a, (1, a.shape[0], 1))
+    return clang.reshape(a, (1, 1, 1))
+
+
+@torchsymbol(name="ravel", method_names=("ravel",))
+def ravel(a):
+    return clang.reshape(a, (a.numel,))
+
+
+@torchsymbol(name="unflatten", method_names=("unflatten",))
+def unflatten(a, dim, sizes):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    sizes = tuple(pyval(s) for s in sizes)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes = tuple(a.shape[dim] // known if s == -1 else s for s in sizes)
+    return clang.reshape(a, a.shape[:dim] + sizes + a.shape[dim + 1 :])
+
+
+@torchsymbol(name="hstack")
+def hstack(tensors):
+    tensors = list(tensors)
+    if tensors[0].ndim == 1:
+        return clang.cat(tensors, 0)
+    return clang.cat(tensors, 1)
+
+
+@torchsymbol(name="vstack")
+def vstack(tensors):
+    tensors = [clang.unsqueeze(t, 0) if t.ndim == 1 else t for t in tensors]
+    return clang.cat(tensors, 0)
+
+
+@torchsymbol(name="dstack")
+def dstack(tensors):
+    fixed = []
+    for t in tensors:
+        if t.ndim == 1:
+            t = clang.reshape(t, (1, t.shape[0], 1))
+        elif t.ndim == 2:
+            t = clang.unsqueeze(t, 2)
+        fixed.append(t)
+    return clang.cat(fixed, 2)
+
+
+@torchsymbol(name="column_stack")
+def column_stack(tensors):
+    fixed = [clang.unsqueeze(t, 1) if t.ndim == 1 else t for t in tensors]
+    return clang.cat(fixed, 1)
+
+
+@torchsymbol(name="select_scatter", method_names=("select_scatter",))
+def select_scatter(a, src, dim, index):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    index = pyval(index)
+    if index < 0:
+        index += a.shape[dim]
+    parts = []
+    if index > 0:
+        parts.append(clang.slice_in_dim(a, 0, index, dim))
+    parts.append(clang.unsqueeze(src, dim))
+    if index + 1 < a.shape[dim]:
+        parts.append(clang.slice_in_dim(a, index + 1, a.shape[dim], dim))
+    return clang.cat(parts, dim)
+
+
+@torchsymbol(name="slice_scatter", method_names=("slice_scatter",))
+def slice_scatter(a, src, dim=0, start=None, end=None, step=1):
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    n = a.shape[dim]
+    start = 0 if start is None else pyval(start)
+    end = n if end is None else builtins.min(pyval(end), n)
+    check(pyval(step) == 1, lambda: "slice_scatter: step != 1 unsupported")
+    parts = []
+    if start > 0:
+        parts.append(clang.slice_in_dim(a, 0, start, dim))
+    parts.append(src)
+    if end < n:
+        parts.append(clang.slice_in_dim(a, end, n, dim))
+    return clang.cat(parts, dim)
+
+
+@torchsymbol(name="scatter", method_names=("scatter",))
+def scatter(a, dim, index, src):
+    if isinstance(src, (int, float, NumberProxy)):
+        src = clang.full_like(clang.take_along_axis(a, index, pyval(dim)), pyval(src))
+    return prims.scatter(a, index, src, canonicalize_dim(a.ndim, pyval(dim)))
+
+
+# factories (widened) --------------------------------------------------------
+
+
+@torchsymbol(name="eye")
+def eye(n, m=None, *, device=None, dtype=None):
+    n = pyval(n)
+    m = n if m is None else pyval(m)
+    dtype = dtypes.to_dtype(dtype) if dtype else dtypes.float32
+    r = clang.unsqueeze(prims.iota(n, dtype=dtypes.int32, device=device), 1)
+    c = clang.unsqueeze(prims.iota(m, dtype=dtypes.int32, device=device), 0)
+    return clang.maybe_convert_to_dtype(clang.eq(r, c), dtype)
+
+
+@torchsymbol(name="empty")
+def empty(*shape, device=None, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return clang.full(shape, 0, device=device, dtype=dtype or dtypes.float32)
+
+
+@torchsymbol(name="empty_like")
+def empty_like(a, *, device=None, dtype=None):
+    return clang.full_like(a, 0, device=device, dtype=dtype)
+
+
+@torchsymbol(name="rand")
+def rand(*shape, key=None, device=None, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    check(key is not None, lambda: "rand requires an rng key (key=)")
+    return prims.uniform(shape, 0.0, 1.0, key=key, device=device, dtype=dtype or dtypes.float32)
+
+
+@torchsymbol(name="randn")
+def randn(*shape, key=None, device=None, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    check(key is not None, lambda: "randn requires an rng key (key=)")
+    return prims.normal(shape, 0.0, 1.0, key=key, device=device, dtype=dtype or dtypes.float32)
+
+
+@torchsymbol(name="randint")
+def randint(low, high, shape, *, key=None, device=None, dtype=None):
+    check(key is not None, lambda: "randint requires an rng key (key=)")
+    return prims.randint(tuple(shape), pyval(low), pyval(high), key=key, device=device, dtype=dtype or dtypes.int32)
+
+
+@torchsymbol(name="rand_like")
+def rand_like(a, *, key=None):
+    return prims.uniform(a.shape, 0.0, 1.0, key=key, device=a.device, dtype=a.dtype)
+
+
+@torchsymbol(name="randn_like")
+def randn_like(a, *, key=None):
+    return prims.normal(a.shape, 0.0, 1.0, key=key, device=a.device, dtype=a.dtype)
+
+
+@torchsymbol(name="bernoulli")
+def bernoulli(p, *, key=None):
+    check(key is not None, lambda: "bernoulli requires an rng key (key=)")
+    u = prims.uniform(p.shape, 0.0, 1.0, key=key, device=p.device, dtype=dtypes.float32)
+    return clang.maybe_convert_to_dtype(clang.lt(u, p), p.dtype)
+
+
+@torchsymbol(name="multinomial")
+def multinomial(probs, num_samples, *, key=None):
+    """Sampling without replacement via the Gumbel top-k trick."""
+    check(key is not None, lambda: "multinomial requires an rng key (key=)")
+    check(probs.ndim in (1, 2), lambda: "multinomial expects 1D/2D probs")
+    u = prims.uniform(probs.shape, 0.0, 1.0, key=key, device=probs.device, dtype=dtypes.float32)
+    eps = 1e-10
+    gumbel = prims.neg(prims.log(clang.add(prims.neg(prims.log(clang.add(u, eps))), eps)))
+    scores = clang.add(prims.log(clang.add(clang.maybe_convert_to_dtype(probs, dtypes.float32), eps)), gumbel)
+    _, idx = prims.topk(scores, pyval(num_samples), probs.ndim - 1)
+    return clang.maybe_convert_to_dtype(idx, dtypes.int64)
+
+
+@torchsymbol(name="randperm")
+def randperm(n, *, key=None, device=None):
+    check(key is not None, lambda: "randperm requires an rng key (key=)")
+    u = prims.uniform((pyval(n),), 0.0, 1.0, key=key, device=device, dtype=dtypes.float32)
+    return clang.maybe_convert_to_dtype(prims.argsort(u, 0, False), dtypes.int64)
+
+
+@torchsymbol(name="logspace")
+def logspace(start, end, steps, base=10.0, *, device=None, dtype=None):
+    lin = linspace.meta(start, end, steps, device=device, dtype=dtypes.float32)
+    out = clang.pow_(float(pyval(base)), lin)
+    return clang.maybe_convert_to_dtype(out, dtypes.to_dtype(dtype) if dtype else dtypes.float32)
+
+
+@torchsymbol(name="scalar_tensor")
+def scalar_tensor(value, *, device=None, dtype=None):
+    return clang.full((), pyval(value), device=device, dtype=dtype or dtypes.to_dtype(type(pyval(value))))
+
+
+@torchsymbol(name="clone", method_names=("clone",))
+def clone(a):
+    return a
+
+
+# matmul family (widened) ----------------------------------------------------
+
+
+@torchsymbol(name="mm")
+def mm(a, b):
+    check(a.ndim == 2 and b.ndim == 2, lambda: "mm expects 2D tensors")
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="bmm")
+def bmm(a, b):
+    check(a.ndim == 3 and b.ndim == 3, lambda: "bmm expects 3D tensors")
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="mv", method_names=("mv",))
+def mv(a, b):
+    check(a.ndim == 2 and b.ndim == 1, lambda: "mv expects (2D, 1D)")
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="dot", method_names=("dot",))
+def dot(a, b):
+    check(a.ndim == 1 and b.ndim == 1, lambda: "dot expects 1D tensors")
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="vdot", method_names=("vdot",))
+def vdot(a, b):
+    return prims.matmul(a, b)
+
+
+@torchsymbol(name="kron", method_names=("kron",))
+def kron(a, b):
+    check(a.ndim == b.ndim, lambda: "kron: rank mismatch (pad with reshape first)")
+    out = clang.mul(
+        clang.reshape(a, tuple(x for s in a.shape for x in (s, 1))),
+        clang.reshape(b, tuple(x for s in b.shape for x in (1, s))),
+    )
+    return clang.reshape(out, tuple(sa * sb for sa, sb in zip(a.shape, b.shape)))
+
+
+@torchsymbol(name="tensordot", method_names=("tensordot",))
+def tensordot(a, b, dims=2):
+    if isinstance(dims, int):
+        axes_a = list(builtins.range(a.ndim - dims, a.ndim))
+        axes_b = list(builtins.range(dims))
+    else:
+        axes_a = [canonicalize_dim(a.ndim, pyval(d)) for d in dims[0]]
+        axes_b = [canonicalize_dim(b.ndim, pyval(d)) for d in dims[1]]
+    free_a = [i for i in builtins.range(a.ndim) if i not in axes_a]
+    free_b = [i for i in builtins.range(b.ndim) if i not in axes_b]
+    pa = clang.permute(a, free_a + axes_a)
+    pb = clang.permute(b, axes_b + free_b)
+    M = 1
+    for i in free_a:
+        M *= a.shape[i]
+    K = 1
+    for i in axes_a:
+        K *= a.shape[i]
+    N = 1
+    for i in free_b:
+        N *= b.shape[i]
+    out = prims.matmul(clang.reshape(pa, (M, K)), clang.reshape(pb, (K, N)))
+    return clang.reshape(out, tuple(a.shape[i] for i in free_a) + tuple(b.shape[i] for i in free_b))
+
+
+@torchsymbol(name="cdist")
+def cdist(x1, x2, p=2.0):
+    """Pairwise distances (..., M, D) x (..., N, D) -> (..., M, N)."""
+    p = pyval(p)
+    if p == 2.0:
+        # |x-y|^2 = |x|^2 + |y|^2 - 2 x·y — one MXU matmul instead of a broadcast blow-up
+        x1n = clang.sum_(clang.mul(x1, x1), -1, True)
+        x2n = clang.sum_(clang.mul(x2, x2), -1, True)
+        cross = prims.matmul(x1, clang.matrix_transpose(x2))
+        sq = clang.add(clang.sub(x1n, clang.mul(2.0, cross)), clang.matrix_transpose(x2n))
+        return prims.sqrt(clang.maximum(sq, 0.0))
+    d = clang.sub(clang.unsqueeze(x1, -2), clang.unsqueeze(x2, -3))
+    return clang.pow_(clang.sum_(clang.pow_(prims.abs(d), p), -1, False), 1.0 / p)
+
+
+@torchsymbol(name="addbmm", method_names=("addbmm",))
+def addbmm(input, batch1, batch2, *, beta=1, alpha=1):
+    out = clang.sum_(prims.matmul(batch1, batch2), 0, False)
+    if pyval(alpha) != 1:
+        out = clang.mul(out, alpha)
+    if pyval(beta) != 0:
+        out = clang.add(out, clang.mul(input, beta) if pyval(beta) != 1 else input)
+    return out
+
+
+@torchsymbol(name="addmv", method_names=("addmv",))
+def addmv(input, mat, vec, *, beta=1, alpha=1):
+    out = prims.matmul(mat, vec)
+    if pyval(alpha) != 1:
+        out = clang.mul(out, alpha)
+    if pyval(beta) != 0:
+        out = clang.add(out, clang.mul(input, beta) if pyval(beta) != 1 else input)
+    return out
+
+
+@torchsymbol(name="addr", method_names=("addr",))
+def addr(input, vec1, vec2, *, beta=1, alpha=1):
+    out = clang.mul(clang.unsqueeze(vec1, 1), clang.unsqueeze(vec2, 0))
+    if pyval(alpha) != 1:
+        out = clang.mul(out, alpha)
+    if pyval(beta) != 0:
+        out = clang.add(out, clang.mul(input, beta) if pyval(beta) != 1 else input)
+    return out
+
+
+# einsum ---------------------------------------------------------------------
+
+from ..core.einsum_utils import expand_ellipsis as _einsum_expand_ellipsis_impl
+
+
+def _einsum_expand_ellipsis(spec: str, operands):
+    return _einsum_expand_ellipsis_impl(spec, [op.ndim for op in operands])
+
+
+def _einsum_pair(s1, x, s2, y, keep):
+    """Contract two einsum operands into one via a single MXU matmul.
+
+    Size-1 dims broadcast against the other operand (ellipsis broadcasting):
+    each shared index takes the max size and size-1 dims are expanded."""
+    sizes = {}
+    for ch, d in zip(s1, x.shape):
+        sizes[ch] = d
+    for ch, d in zip(s2, y.shape):
+        sizes[ch] = builtins.max(sizes.get(ch, 1), d)
+    set1, set2 = set(s1), set(s2)
+    if builtins.any(x.shape[i] != sizes[ch] for i, ch in enumerate(s1)):
+        x = clang.expand(x, tuple(sizes[ch] for ch in s1))
+    if builtins.any(y.shape[i] != sizes[ch] for i, ch in enumerate(s2)):
+        y = clang.expand(y, tuple(sizes[ch] for ch in s2))
+    # pre-sum indices that appear in only one operand and are not needed later
+    drop1 = [ch for ch in s1 if ch not in set2 and ch not in keep]
+    if drop1:
+        dims = tuple(s1.index(ch) for ch in drop1)
+        x = clang.sum_(x, dims, False)
+        s1 = "".join(ch for ch in s1 if ch not in drop1)
+        set1 = set(s1)
+    drop2 = [ch for ch in s2 if ch not in set1 and ch not in keep]
+    if drop2:
+        dims = tuple(s2.index(ch) for ch in drop2)
+        y = clang.sum_(y, dims, False)
+        s2 = "".join(ch for ch in s2 if ch not in drop2)
+        set2 = set(s2)
+    batch = [ch for ch in s1 if ch in set2 and ch in keep]
+    contract = [ch for ch in s1 if ch in set2 and ch not in keep]
+    mdims = [ch for ch in s1 if ch not in set2]
+    ndims = [ch for ch in s2 if ch not in set1]
+    # permute to (batch, m, contract) and (batch, contract, n)
+    perm1 = [s1.index(ch) for ch in batch + mdims + contract]
+    perm2 = [s2.index(ch) for ch in batch + contract + ndims]
+    if perm1 != list(builtins.range(len(s1))):
+        x = clang.permute(x, perm1)
+    if perm2 != list(builtins.range(len(s2))):
+        y = clang.permute(y, perm2)
+    B = 1
+    for ch in batch:
+        B *= sizes[ch]
+    M = 1
+    for ch in mdims:
+        M *= sizes[ch]
+    K = 1
+    for ch in contract:
+        K *= sizes[ch]
+    N = 1
+    for ch in ndims:
+        N *= sizes[ch]
+    x2 = clang.reshape(x, (B, M, K))
+    y2 = clang.reshape(y, (B, K, N))
+    out = prims.matmul(x2, y2)
+    out_spec = "".join(batch + mdims + ndims)
+    out_shape = tuple(sizes[ch] for ch in out_spec)
+    return out_spec, clang.reshape(out, out_shape)
+
+
+@torchsymbol(name="einsum")
+def einsum(equation, *operands):
+    """General einsum, decomposed to transpose/reshape/matmul/sum prims so the
+    MXU and existing grad rules are used (reference: thunder traces
+    torch.einsum op-by-op; here decomposition is the TPU-native lowering).
+    Falls back to the EINSUM prim for specs with repeated in-operand indices."""
+    if len(operands) == 1 and isinstance(operands[0], (tuple, list)):
+        operands = tuple(operands[0])
+    equation = pyval(equation)
+    in_specs, out_spec = _einsum_expand_ellipsis(equation, operands)
+    # repeated index inside one operand (diagonal) -> prim fallback
+    for sub in in_specs:
+        if len(set(sub)) != len(sub):
+            return prims.einsum(equation, *operands)
+    if len(operands) == 1:
+        s, x = in_specs[0], operands[0]
+        drop = [ch for ch in s if ch not in out_spec]
+        if drop:
+            x = clang.sum_(x, tuple(s.index(ch) for ch in drop), False)
+            s = "".join(ch for ch in s if ch in out_spec)
+        perm = [s.index(ch) for ch in out_spec]
+        return clang.permute(x, perm) if perm != list(builtins.range(len(s))) else x
+    spec, acc = in_specs[0], operands[0]
+    for i in builtins.range(1, len(operands)):
+        keep = set(out_spec)
+        for j in builtins.range(i + 1, len(operands)):
+            keep |= set(in_specs[j])
+        spec, acc = _einsum_pair(spec, acc, in_specs[i], operands[i], keep)
+    drop = [ch for ch in spec if ch not in out_spec]
+    if drop:
+        acc = clang.sum_(acc, tuple(spec.index(ch) for ch in drop), False)
+        spec = "".join(ch for ch in spec if ch in out_spec)
+    perm = [spec.index(ch) for ch in out_spec]
+    return clang.permute(acc, perm) if perm != list(builtins.range(len(spec))) else acc
+
+
+# pooling (TPU-native: lowers to XLA ReduceWindow via the reduce_window prim) -
+
+
+def _pool_args(kernel_size, stride, padding, n):
+    ks = (kernel_size,) * n if isinstance(kernel_size, int) else tuple(pyval(k) for k in kernel_size)
+    st = ks if stride is None else ((stride,) * n if isinstance(stride, int) else tuple(pyval(s) for s in stride))
+    pd = (padding,) * n if isinstance(padding, int) else tuple(pyval(p) for p in padding)
+    return ks, st, pd
+
+
+@torchsymbol(name="max_pool2d", id="torch.nn.functional.max_pool2d")
+def max_pool2d(a, kernel_size, stride=None, padding=0):
+    ks, st, pd = _pool_args(kernel_size, stride, padding, 2)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    return prims.reduce_window(a, window, strides, pads, op="max")
+
+
+@torchsymbol(name="max_pool1d", id="torch.nn.functional.max_pool1d")
+def max_pool1d(a, kernel_size, stride=None, padding=0):
+    ks, st, pd = _pool_args(kernel_size, stride, padding, 1)
+    return prims.reduce_window(a, (1, 1) + ks, (1, 1) + st, ((0, 0), (0, 0)) + tuple((p, p) for p in pd), op="max")
+
+
+@torchsymbol(name="max_pool3d", id="torch.nn.functional.max_pool3d")
+def max_pool3d(a, kernel_size, stride=None, padding=0):
+    ks, st, pd = _pool_args(kernel_size, stride, padding, 3)
+    return prims.reduce_window(a, (1, 1) + ks, (1, 1) + st, ((0, 0), (0, 0)) + tuple((p, p) for p in pd), op="max")
+
+
+def _avg_pool(a, kernel_size, stride, padding, n, count_include_pad):
+    ks, st, pd = _pool_args(kernel_size, stride, padding, n)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    s = prims.reduce_window(a, window, strides, pads, op="sum")
+    if count_include_pad or builtins.all(p == 0 for p in pd):
+        denom = 1.0
+        for k in ks:
+            denom *= k
+        return clang.true_divide(s, float(denom))
+    ones = clang.full_like(a, 1.0)
+    counts = prims.reduce_window(ones, window, strides, pads, op="sum")
+    return clang.true_divide(s, counts)
+
+
+@torchsymbol(name="avg_pool2d", id="torch.nn.functional.avg_pool2d")
+def avg_pool2d(a, kernel_size, stride=None, padding=0, count_include_pad=True):
+    return _avg_pool(a, kernel_size, stride, padding, 2, count_include_pad)
+
+
+@torchsymbol(name="avg_pool1d", id="torch.nn.functional.avg_pool1d")
+def avg_pool1d(a, kernel_size, stride=None, padding=0, count_include_pad=True):
+    return _avg_pool(a, kernel_size, stride, padding, 1, count_include_pad)
+
+
+@torchsymbol(name="avg_pool3d", id="torch.nn.functional.avg_pool3d")
+def avg_pool3d(a, kernel_size, stride=None, padding=0, count_include_pad=True):
+    return _avg_pool(a, kernel_size, stride, padding, 3, count_include_pad)
+
+
+@torchsymbol(name="adaptive_avg_pool2d", id="torch.nn.functional.adaptive_avg_pool2d")
+def adaptive_avg_pool2d(a, output_size):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(pyval(o) for o in output_size)
+    H, W = a.shape[-2], a.shape[-1]
+    check(H % oh == 0 and W % ow == 0, lambda: f"adaptive_avg_pool2d: {H}x{W} not divisible by {oh}x{ow}")
+    return _avg_pool(a, (H // oh, W // ow), (H // oh, W // ow), 0, 2, True)
+
+
+@torchsymbol(name="adaptive_max_pool2d", id="torch.nn.functional.adaptive_max_pool2d")
+def adaptive_max_pool2d(a, output_size):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(pyval(o) for o in output_size)
+    H, W = a.shape[-2], a.shape[-1]
+    check(H % oh == 0 and W % ow == 0, lambda: f"adaptive_max_pool2d: {H}x{W} not divisible by {oh}x{ow}")
+    return max_pool2d.meta(a, (H // oh, W // ow), (H // oh, W // ow), 0)
+
+
+# convs (widened) ------------------------------------------------------------
+
+
+@torchsymbol(name="conv3d", id="torch.nn.functional.conv3d")
+def conv3d(a, weight, bias=None, stride=(1, 1, 1), padding=(0, 0, 0), dilation=(1, 1, 1), groups=1):
+    stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    out = prims.convolution(a, weight, None, stride, padding, dilation, groups)
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, (1, bias.shape[0], 1, 1, 1)))
+    return out
+
+
+def _conv_transpose_nd(a, weight, bias, stride, padding, output_padding, dilation, groups, n):
+    stride = (stride,) * n if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * n if isinstance(padding, int) else tuple(padding)
+    output_padding = (output_padding,) * n if isinstance(output_padding, int) else tuple(output_padding)
+    dilation = (dilation,) * n if isinstance(dilation, int) else tuple(dilation)
+    out = prims.conv_transpose(a, weight, None, stride, padding, output_padding, dilation, groups)
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, (1, bias.shape[0]) + (1,) * n))
+    return out
+
+
+@torchsymbol(name="conv_transpose1d", id="torch.nn.functional.conv_transpose1d")
+def conv_transpose1d(a, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1):
+    return _conv_transpose_nd(a, weight, bias, stride, padding, output_padding, dilation, groups, 1)
+
+
+@torchsymbol(name="conv_transpose2d", id="torch.nn.functional.conv_transpose2d")
+def conv_transpose2d(a, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1):
+    return _conv_transpose_nd(a, weight, bias, stride, padding, output_padding, dilation, groups, 2)
+
+
+@torchsymbol(name="conv_transpose3d", id="torch.nn.functional.conv_transpose3d")
+def conv_transpose3d(a, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1):
+    return _conv_transpose_nd(a, weight, bias, stride, padding, output_padding, dilation, groups, 3)
+
+
+# norms (widened) ------------------------------------------------------------
+
+
+@torchsymbol(name="batch_norm", id="torch.nn.functional.batch_norm")
+def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
+               training=False, momentum=0.1, eps=1e-5):
+    """Functional batch norm. In training mode batch statistics are used; the
+    running-stat update is the caller's job (functional framework — the nn
+    layer returns updated stats explicitly, unlike torch's in-place update)."""
+    compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
+    if training or running_mean is None:
+        dims = (0,) + tuple(builtins.range(2, a.ndim))
+        m = clang.mean(compute, dims, keepdim=True)
+        centered = clang.sub(compute, m)
+        v = clang.mean(clang.mul(centered, centered), dims, keepdim=True)
+    else:
+        m = clang.reshape(running_mean, (1, running_mean.shape[0]) + (1,) * (a.ndim - 2))
+        v = clang.reshape(running_var, (1, running_var.shape[0]) + (1,) * (a.ndim - 2))
+        centered = clang.sub(compute, m)
+    out = clang.mul(centered, prims.rsqrt(clang.add(v, eps)))
+    out = clang.maybe_convert_to_dtype(out, a.dtype)
+    if weight is not None:
+        out = clang.mul(out, clang.reshape(weight, (1, weight.shape[0]) + (1,) * (a.ndim - 2)))
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, (1, bias.shape[0]) + (1,) * (a.ndim - 2)))
+    return out
+
+
+@torchsymbol(name="group_norm", id="torch.nn.functional.group_norm")
+def group_norm(a, num_groups, weight=None, bias=None, eps=1e-5):
+    N, C = a.shape[0], a.shape[1]
+    G = pyval(num_groups)
+    check(C % G == 0, lambda: f"group_norm: {C} channels not divisible by {G} groups")
+    spatial = a.shape[2:]
+    compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
+    grouped = clang.reshape(compute, (N, G, C // G) + spatial)
+    dims = tuple(builtins.range(2, grouped.ndim))
+    m = clang.mean(grouped, dims, keepdim=True)
+    centered = clang.sub(grouped, m)
+    v = clang.mean(clang.mul(centered, centered), dims, keepdim=True)
+    out = clang.mul(centered, prims.rsqrt(clang.add(v, eps)))
+    out = clang.reshape(out, a.shape)
+    out = clang.maybe_convert_to_dtype(out, a.dtype)
+    view = (1, C) + (1,) * (a.ndim - 2)
+    if weight is not None:
+        out = clang.mul(out, clang.reshape(weight, view))
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, view))
+    return out
+
+
+@torchsymbol(name="instance_norm", id="torch.nn.functional.instance_norm")
+def instance_norm(a, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.1, eps=1e-5):
+    dims = tuple(builtins.range(2, a.ndim))
+    compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
+    m = clang.mean(compute, dims, keepdim=True)
+    centered = clang.sub(compute, m)
+    v = clang.mean(clang.mul(centered, centered), dims, keepdim=True)
+    out = clang.mul(centered, prims.rsqrt(clang.add(v, eps)))
+    out = clang.maybe_convert_to_dtype(out, a.dtype)
+    view = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+    if weight is not None:
+        out = clang.mul(out, clang.reshape(weight, view))
+    if bias is not None:
+        out = clang.add(out, clang.reshape(bias, view))
+    return out
+
+
+@torchsymbol(name="normalize", id="torch.nn.functional.normalize")
+def normalize(a, p=2.0, dim=1, eps=1e-12):
+    n = norm.meta(a, pyval(p), pyval(dim), True)
+    return clang.true_divide(a, clang.maximum(n, eps))
+
+
+@torchsymbol(name="local_response_norm", id="torch.nn.functional.local_response_norm")
+def local_response_norm(a, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = clang.mul(a, a)
+    n = pyval(size)
+    pads = ((0, 0), ((n - 1) // 2, n // 2)) + ((0, 0),) * (a.ndim - 2)
+    window = (1, n) + (1,) * (a.ndim - 2)
+    strides = (1,) * a.ndim
+    s = prims.reduce_window(sq, window, strides, pads, op="sum")
+    div = clang.pow_(clang.add(k, clang.mul(alpha / n, s)), beta)
+    return clang.true_divide(a, div)
+
+
+# resampling -----------------------------------------------------------------
+
+
+@torchsymbol(name="pixel_shuffle", id="torch.nn.functional.pixel_shuffle")
+def pixel_shuffle(a, upscale_factor):
+    r = pyval(upscale_factor)
+    N, C, H, W = a.shape
+    check(C % (r * r) == 0, lambda: f"pixel_shuffle: {C} % {r*r}")
+    out = clang.reshape(a, (N, C // (r * r), r, r, H, W))
+    out = clang.permute(out, (0, 1, 4, 2, 5, 3))
+    return clang.reshape(out, (N, C // (r * r), H * r, W * r))
+
+
+@torchsymbol(name="pixel_unshuffle", id="torch.nn.functional.pixel_unshuffle")
+def pixel_unshuffle(a, downscale_factor):
+    r = pyval(downscale_factor)
+    N, C, H, W = a.shape
+    out = clang.reshape(a, (N, C, H // r, r, W // r, r))
+    out = clang.permute(out, (0, 1, 3, 5, 2, 4))
+    return clang.reshape(out, (N, C * r * r, H // r, W // r))
+
+
+@torchsymbol(name="interpolate", id="torch.nn.functional.interpolate")
+def interpolate(a, size=None, scale_factor=None, mode="nearest"):
+    """Static-shape interpolate: nearest / bilinear (align_corners=False)."""
+    n_spatial = a.ndim - 2
+    in_sp = a.shape[2:]
+    if size is not None:
+        out_sp = (size,) * n_spatial if isinstance(size, int) else tuple(pyval(s) for s in size)
+    else:
+        sf = (scale_factor,) * n_spatial if isinstance(scale_factor, (int, float)) else tuple(scale_factor)
+        out_sp = tuple(int(s * f) for s, f in zip(in_sp, sf))
+    if mode == "nearest":
+        out = a
+        for i, (si, so) in enumerate(zip(in_sp, out_sp)):
+            dim = 2 + i
+            idx_f = clang.mul(clang.add(prims.iota(so, dtype=dtypes.float32, device=a.device), 0.0), si / so)
+            idx = clang.maybe_convert_to_dtype(prims.floor(idx_f), dtypes.int32)
+            out = clang.take(out, idx, dim)
+        return out
+    check(mode in ("bilinear", "linear"), lambda: f"interpolate mode {mode} unsupported")
+    out = a
+    for i, (si, so) in enumerate(zip(in_sp, out_sp)):
+        dim = 2 + i
+        # align_corners=False source coordinates
+        coord = clang.sub(clang.mul(clang.add(prims.iota(so, dtype=dtypes.float32, device=a.device), 0.5), si / so), 0.5)
+        coord = clang.maximum(clang.minimum(coord, float(si - 1)), 0.0)
+        lo_f = prims.floor(coord)
+        w_hi = clang.sub(coord, lo_f)
+        lo = clang.maybe_convert_to_dtype(lo_f, dtypes.int32)
+        hi = clang.minimum(clang.add(lo, 1), si - 1)
+        g_lo = clang.take(out, lo, dim)
+        g_hi = clang.take(out, hi, dim)
+        shape = [1] * out.ndim
+        shape[dim] = so
+        w = clang.reshape(w_hi, tuple(shape))
+        out = clang.add(clang.mul(g_lo, clang.sub(1.0, w)), clang.mul(g_hi, w))
+    return out
+
+
+# distances ------------------------------------------------------------------
+
+
+@torchsymbol(name="cosine_similarity", id="torch.nn.functional.cosine_similarity")
+def cosine_similarity(x1, x2, dim=1, eps=1e-8):
+    num = clang.sum_(clang.mul(x1, x2), dim, False)
+    n1 = prims.sqrt(clang.sum_(clang.mul(x1, x1), dim, False))
+    n2 = prims.sqrt(clang.sum_(clang.mul(x2, x2), dim, False))
+    return clang.true_divide(num, clang.maximum(clang.mul(n1, n2), eps))
+
+
+@torchsymbol(name="pairwise_distance", id="torch.nn.functional.pairwise_distance")
+def pairwise_distance(x1, x2, p=2.0, eps=1e-6):
+    d = clang.add(clang.sub(x1, x2), eps)
+    return norm.meta(d, pyval(p), -1, False)
+
+
+# losses (widened) -----------------------------------------------------------
+
+
+def _apply_reduction(loss, reduction):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return clang.sum_(loss)
+    return clang.mean(loss)
+
+
+@torchsymbol(name="l1_loss", id="torch.nn.functional.l1_loss")
+def l1_loss(input, target, reduction="mean"):
+    return _apply_reduction(prims.abs(clang.sub(input, target)), reduction)
+
+
+@torchsymbol(name="smooth_l1_loss", id="torch.nn.functional.smooth_l1_loss")
+def smooth_l1_loss(input, target, reduction="mean", beta=1.0):
+    d = clang.sub(input, target)
+    ad = prims.abs(d)
+    quad = clang.true_divide(clang.mul(clang.mul(d, d), 0.5), beta)
+    lin = clang.sub(ad, 0.5 * beta)
+    return _apply_reduction(clang.where(clang.lt(ad, beta), quad, lin), reduction)
+
+
+@torchsymbol(name="huber_loss", id="torch.nn.functional.huber_loss")
+def huber_loss(input, target, reduction="mean", delta=1.0):
+    d = clang.sub(input, target)
+    ad = prims.abs(d)
+    quad = clang.mul(clang.mul(d, d), 0.5)
+    lin = clang.mul(delta, clang.sub(ad, 0.5 * delta))
+    return _apply_reduction(clang.where(clang.lt(ad, delta), quad, lin), reduction)
+
+
+@torchsymbol(name="binary_cross_entropy", id="torch.nn.functional.binary_cross_entropy")
+def binary_cross_entropy(input, target, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = prims.neg(clang.add(
+        clang.mul(target, prims.log(clang.maximum(input, eps))),
+        clang.mul(clang.sub(1.0, target), prims.log(clang.maximum(clang.sub(1.0, input), eps))),
+    ))
+    if weight is not None:
+        loss = clang.mul(loss, weight)
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="binary_cross_entropy_with_logits", id="torch.nn.functional.binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(input, target, weight=None, pos_weight=None, reduction="mean"):
+    # max(x,0) - x*z + log(1 + exp(-|x|)) — numerically stable
+    neg_abs = prims.neg(prims.abs(input))
+    loss = clang.add(clang.sub(clang.maximum(input, 0.0), clang.mul(input, target)),
+                     prims.log1p(prims.exp(neg_abs)))
+    if pos_weight is not None:
+        # general form: (1 + (p-1) z) * softplus(-x) + (1-z) x for x>0 branch — use direct formula
+        log_sig = prims.neg(clang.add(clang.maximum(prims.neg(input), 0.0),
+                                      prims.log1p(prims.exp(neg_abs))))
+        log_sig_neg = clang.sub(log_sig, input)
+        loss = prims.neg(clang.add(clang.mul(clang.mul(target, pos_weight), log_sig),
+                                   clang.mul(clang.sub(1.0, target), log_sig_neg)))
+    if weight is not None:
+        loss = clang.mul(loss, weight)
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="kl_div", id="torch.nn.functional.kl_div")
+def kl_div(input, target, reduction="mean", log_target=False):
+    if log_target:
+        loss = clang.mul(prims.exp(target), clang.sub(target, input))
+    else:
+        eps_t = clang.maximum(target, 1e-12)
+        loss = clang.mul(target, clang.sub(prims.log(eps_t), input))
+    if reduction == "batchmean":
+        return clang.true_divide(clang.sum_(loss), input.shape[0])
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="soft_margin_loss", id="torch.nn.functional.soft_margin_loss")
+def soft_margin_loss(input, target, reduction="mean"):
+    return _apply_reduction(prims.log1p(prims.exp(prims.neg(clang.mul(input, target)))), reduction)
+
+
+@torchsymbol(name="hinge_embedding_loss", id="torch.nn.functional.hinge_embedding_loss")
+def hinge_embedding_loss(input, target, margin=1.0, reduction="mean"):
+    pos = input
+    neg = clang.maximum(clang.sub(margin, input), 0.0)
+    loss = clang.where(clang.gt(target, 0), pos, neg)
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="margin_ranking_loss", id="torch.nn.functional.margin_ranking_loss")
+def margin_ranking_loss(input1, input2, target, margin=0.0, reduction="mean"):
+    loss = clang.maximum(clang.add(clang.mul(prims.neg(target), clang.sub(input1, input2)), margin), 0.0)
+    return _apply_reduction(loss, reduction)

@@ -63,7 +63,11 @@ def has_grad_rule(sym_id) -> bool:
 
 
 # ops that fall back to jax.vjp of their jax impl (op-by-op, unfused)
-JAX_VJP_FALLBACK: set = {PrimIDs.CONVOLUTION, PrimIDs.GROUPED_MM, PrimIDs.ATAN2, PrimIDs.CUMSUM}
+JAX_VJP_FALLBACK: set = {
+    PrimIDs.CONVOLUTION, PrimIDs.GROUPED_MM, PrimIDs.ATAN2, PrimIDs.CUMSUM,
+    PrimIDs.CUMPROD, PrimIDs.REDUCE_WINDOW, PrimIDs.CONV_TRANSPOSE, PrimIDs.EINSUM,
+    PrimIDs.DIGAMMA, PrimIDs.SCATTER,
+}
 
 
 # ---------------------------------------------------------------------------
@@ -651,6 +655,61 @@ def _sum_bwd(in_shape, dims, in_dtype, g):
     kept = tuple(d for d in range(len(in_shape)) if d not in dims)
     g = prims.convert_element_type(g, in_dtype) if g.dtype != in_dtype else g
     return prims.broadcast_in_dim(g, in_shape, kept)
+
+
+@register_augmented_forward(PrimIDs.LOG10)
+def _log10_aug(a):
+    return VJPResult(prims.log10(a), (a,))
+
+
+@register_backward(PrimIDs.LOG10)
+def _log10_bwd(a, g):
+    return prims.div(g, prims.mul(a, math.log(10.0)))
+
+
+@register_augmented_forward(PrimIDs.LGAMMA)
+def _lgamma_aug(a):
+    return VJPResult(prims.lgamma(a), (a,))
+
+
+@register_backward(PrimIDs.LGAMMA)
+def _lgamma_bwd(a, g):
+    return prims.mul(g, prims.digamma(a))
+
+
+@register_augmented_forward(PrimIDs.HYPOT)
+def _hypot_aug(a, b):
+    out = prims.hypot(a, b)
+    return VJPResult(out, (a, b, out))
+
+
+@register_backward(PrimIDs.HYPOT)
+def _hypot_bwd(a, b, out, g):
+    return prims.mul(g, prims.div(a, out)), prims.mul(g, prims.div(b, out))
+
+
+@register_augmented_forward(PrimIDs.COPYSIGN)
+def _copysign_aug(a, b):
+    out = prims.copysign(a, b)
+    return VJPResult(out, (a, out))
+
+
+@register_backward(PrimIDs.COPYSIGN)
+def _copysign_bwd(a, out, g):
+    # d|a|·sign(b)/da = sign(a)·sign(b) = sign(out)·sign(a)
+    return prims.mul(g, prims.mul(prims.sign(out), prims.sign(a))), None
+
+
+@register_augmented_forward(PrimIDs.CUMMAX)
+def _cummax_aug(a, dim):
+    values, indices = prims.cummax(a, dim)
+    return VJPResult((values, indices), (a.shape, a.dtype, indices, dim))
+
+
+@register_backward(PrimIDs.CUMMAX)
+def _cummax_bwd(in_shape, in_dtype, indices, dim, g_values, g_indices=None):
+    zeros = prims.full(in_shape, 0.0, dtype=in_dtype)
+    return prims.scatter_add(zeros, indices, g_values, dim)
 
 
 @register_augmented_forward(PrimIDs.AMAX)
