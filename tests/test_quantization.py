@@ -1,0 +1,146 @@
+"""NF4 / int8 / fp8 weight quantization (reference analogs:
+BitsAndBytesLinearQuant4bit thunder/transforms/quantization.py:47,
+TEInference8BitTransform thunder/transforms/te_inference.py:116)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+
+
+class _Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(64, 32, seed=1)
+        self.fc2 = nn.Linear(32, 8, seed=2)
+
+    def forward(self, x):
+        return self.fc2(ltorch.relu(self.fc1(x)))
+
+
+def test_nf4_roundtrip(rng):
+    from thunder_tpu.transforms.quantization import dequantize_nf4, quantize_nf4
+
+    w = rng.randn(16, 64).astype(np.float32)
+    packed, absmax = quantize_nf4(w)
+    deq = np.asarray(dequantize_nf4(packed, absmax, (16, 64)))
+    # NF4 is lossy, but per-block relative error should be bounded
+    err = np.abs(deq - w).max() / np.abs(w).max()
+    assert err < 0.15, err
+    assert np.asarray(packed).dtype == np.uint8
+    assert packed.size == w.size // 2
+
+
+def test_nf4_transform_forward(rng):
+    from thunder_tpu.transforms.quantization import QuantizeNF4Transform
+
+    net = _Net()
+    x = jnp.asarray(rng.rand(4, 64).astype(np.float32))
+    ref = np.asarray(tt.jit(net)(x))
+    net2 = _Net()
+    tm = tt.jit(net2, transforms=[QuantizeNF4Transform(target_predicate=lambda n, m: n == "fc1")])
+    out = np.asarray(tm(x))
+    assert out.shape == ref.shape
+    # quantized forward approximates the full-precision one
+    assert np.abs(out - ref).max() < 0.2 * max(1.0, np.abs(ref).max())
+
+
+def test_nf4_grad_flows_to_activations(rng):
+    from thunder_tpu.transforms.quantization import QuantizeNF4Transform
+
+    net = _Net()
+
+    class Head(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.body = net
+
+        def forward(self, x, y):
+            return ltorch.mse_loss(self.body(x), y)
+
+    tm = tt.jit(Head(), transforms=[QuantizeNF4Transform(target_predicate=lambda n, m: n.endswith("fc1"))])
+    from thunder_tpu.training import TrainStep
+
+    step = TrainStep(tm, optim.AdamW(lr=0.05))
+    x = jnp.asarray(rng.rand(8, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8, 8).astype(np.float32))
+    l0 = float(step(x, y))
+    for _ in range(5):
+        step(x, y)
+    assert float(step(x, y)) < l0
+
+
+def test_fp8_weight_roundtrip(rng):
+    from thunder_tpu.transforms.fp8_inference import quantize_fp8_weight
+
+    w = rng.randn(16, 32).astype(np.float32)
+    q, s = quantize_fp8_weight(w)
+    deq = np.asarray(q, np.float32) * np.asarray(s)[:, None]
+    rel = np.abs(deq - w).max() / np.abs(w).max()
+    assert rel < 0.1, rel
+
+
+def test_fp8_transform_forward(rng):
+    from thunder_tpu.transforms.fp8_inference import FP8LinearInference
+
+    net = _Net()
+    x = jnp.asarray(rng.rand(4, 64).astype(np.float32))
+    ref = np.asarray(tt.jit(net)(x))
+    net2 = _Net()
+    tm = tt.jit(net2, transforms=[FP8LinearInference(min_features=8)])
+    out = np.asarray(tm(x))
+    assert out.shape == ref.shape
+    assert np.abs(out - ref).max() < 0.25 * max(1.0, np.abs(ref).max())
+
+
+def test_extraction_only_prologue(rng):
+    from thunder_tpu.transforms import ExtractionOnlyPrologueTransform
+    from thunder_tpu.core.prims import PrimIDs
+
+    tm = tt.jit(_Net(), transforms=[ExtractionOnlyPrologueTransform()])
+    x = jnp.asarray(rng.rand(2, 64).astype(np.float32))
+    tm(x)
+    pro = tm.last_prologue_traces()[-1] if hasattr(tm, "last_prologue_traces") else None
+    if pro is not None:
+        check_ids = {PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE}
+        assert not [b for b in pro.bound_symbols if b.sym.id in check_ids]
+
+
+def test_nf4_nondefault_block_size(rng):
+    from thunder_tpu.transforms.quantization import QuantizeNF4Transform
+
+    net = _Net()
+    x = jnp.asarray(rng.rand(4, 64).astype(np.float32))
+    ref = np.asarray(tt.jit(net)(x))
+    net2 = _Net()
+    tm = tt.jit(net2, transforms=[QuantizeNF4Transform(block_size=32)])
+    out = np.asarray(tm(x))
+    assert out.shape == ref.shape
+    assert np.abs(out - ref).max() < 0.2 * max(1.0, np.abs(ref).max())
+
+
+def test_quantized_bias_trains(rng):
+    """Bias of a quantized linear must receive real (non-zero) gradients."""
+    from thunder_tpu.transforms.quantization import QuantizeInt8Transform
+    from thunder_tpu.training import TrainStep
+
+    class Head(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.body = _Net()
+
+        def forward(self, x, y):
+            return ltorch.mse_loss(self.body(x), y)
+
+    net = Head()
+    tm = tt.jit(net, transforms=[QuantizeInt8Transform(target_predicate=lambda n, m: n.endswith("fc2"))])
+    b_before = np.asarray(net.body.fc2._parameters["bias"].data).copy()
+    step = TrainStep(tm, optim.AdamW(lr=0.05))
+    x = jnp.asarray(rng.rand(8, 64).astype(np.float32))
+    y = jnp.asarray(rng.rand(8, 8).astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    b_after = np.asarray(net.body.fc2._parameters["bias"].data)
+    assert np.abs(b_after - b_before).max() > 1e-5, "bias froze under quantization"

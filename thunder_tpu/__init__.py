@@ -45,6 +45,7 @@ from .executors import jaxex  # noqa: E402
 from .executors import xlaex  # noqa: E402
 from .ops import ltorch  # noqa: E402  (registers tensor methods)
 from .ops import clang  # noqa: E402
+from .ops import auto_register  # noqa: E402  (registers fallback op catalog)
 
 try:
     from .executors import pallasex  # noqa: E402

@@ -2,6 +2,14 @@ from ..core.transform_common import Transform
 from .autocast import AutocastTransform, autocast
 from .constant_folding import ConstantFolding, fold_constants
 from .materialization import MaterializationTransform, MetaArray, meta_device
-from .prune_prologue_checks import PrunePrologueChecks
-from .quantization import QuantizeInt8Transform, quantize_int8
+from .fp8_inference import FP8LinearInference, quantize_fp8_weight
+from .lora import LORATransform
+from .prune_prologue_checks import ExtractionOnlyPrologueTransform, PrunePrologueChecks
+from .quantization import (
+    QuantizeInt8Transform,
+    QuantizeNF4Transform,
+    dequantize_nf4,
+    quantize_int8,
+    quantize_nf4,
+)
 from .remat import RematTransform, checkpoint

@@ -1,0 +1,85 @@
+"""FP8 inference transform — the TPU analog of the reference's
+TEInference8BitTransform (thunder/transforms/te_inference.py:116, which wraps
+TransformerEngine FP8 linears for inference).
+
+On TPU there is no TransformerEngine; instead weights are stored in
+float8_e4m3 with per-output-channel scales and the matmul accumulates in
+float32 (``preferred_element_type``), which maps onto the MXU's native
+low-precision path. Activations are cast to e4m3 with a per-call dynamic
+per-tensor scale (current-scaling; TE's delayed-scaling amax history would
+require carrying state across calls and is not implemented)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes
+from ..core.proxies import TensorProxy, pyval
+from ..core.symbol import OpTags, Symbol
+from ..core.transform_common import Transform
+from ..executors.jaxex import ex as jax_ex
+from ..nn.module import Parameter
+
+E4M3_MAX = 448.0
+
+
+def quantize_fp8_weight(w) -> tuple:
+    """w (out, in) -> (e4m3 weights, f32 per-row scales)."""
+    amax = jnp.maximum(jnp.max(jnp.abs(w), axis=1, keepdims=True), 1e-12)
+    scale = (amax / E4M3_MAX).astype(jnp.float32)
+    q = (w / scale).astype(jnp.float8_e4m3fn)
+    return q, scale[:, 0]
+
+
+def _fp8_linear_meta(x, qweight, scale, bias=None):
+    return TensorProxy(shape=x.shape[:-1] + (qweight.shape[0],), dtype=x.dtype, device=x.device)
+
+
+def _fp8_linear_impl(x, qweight, scale, bias=None):
+    # per-tensor dynamic activation scaling into e4m3, f32 accumulation
+    x_amax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    x_scale = (x_amax / E4M3_MAX).astype(jnp.float32)
+    xq = (x / x_scale).astype(jnp.float8_e4m3fn)
+    acc = jnp.matmul(xq, qweight.T, preferred_element_type=jnp.float32)
+    out = acc * (x_scale * scale[None, :])
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+fp8_linear = Symbol("fp8_linear", _fp8_linear_meta, id="fp8.linear", is_prim=True, module="fp8",
+                    tags=(OpTags.MATMUL_OP,))
+jax_ex.register_implementation(fp8_linear.id, _fp8_linear_impl)
+
+
+class FP8LinearInference(Transform):
+    """Swap nn.Linear weights to float8_e4m3 for inference (reference
+    TEInference8BitTransform analog; no backward — inference only)."""
+
+    def __init__(self, target_predicate=None, min_features: int = 64):
+        self.target_predicate = target_predicate or (lambda name, mod: True)
+        # tiny layers lose more accuracy than time; keep them in high precision
+        self.min_features = min_features
+
+    def transform_module(self, tmodule) -> None:
+        from .. import nn as _nn
+
+        root = tmodule.module if hasattr(tmodule, "module") else tmodule
+        for name, mod in list(root.named_modules()):
+            if not isinstance(mod, _nn.Linear) or not self.target_predicate(name, mod):
+                continue
+            w = jnp.asarray(mod.weight.data)
+            if min(w.shape) < self.min_features:
+                continue
+            q, s = quantize_fp8_weight(w)
+            mod._parameters["weight"] = Parameter(q, requires_grad=False)
+            mod.register_parameter("fp8_scale", Parameter(s, requires_grad=False))
+
+            def make_fwd(m):
+                def forward(x):
+                    return fp8_linear(x, m._parameters["weight"], m._parameters["fp8_scale"],
+                                      m._parameters.get("bias"))
+
+                return forward
+
+            mod.forward = make_fwd(mod)

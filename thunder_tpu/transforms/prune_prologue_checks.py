@@ -19,3 +19,11 @@ class PrunePrologueChecks(Transform):
         out.bound_symbols = [b for b in prologue_trc.bound_symbols if b.sym.id not in _CHECK_IDS]
         out.set_provenance("Prune prologue checks")
         return out, computation_trc
+
+
+class ExtractionOnlyPrologueTransform(PrunePrologueChecks):
+    """Keep only extraction (unpack) prims in the prologue (reference
+    thunder/transforms/extraction_only_prologue_transform.py). Currently the
+    prologue's non-check content is exactly the unpacks, so this shares the
+    check-pruning implementation; it exists as a distinct name so recipes can
+    request the reference's semantics explicitly."""
