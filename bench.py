@@ -23,13 +23,21 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     import jax.numpy as jnp
     import numpy as np
 
+    import thunder_tpu as tt
     from thunder_tpu import optim
     from thunder_tpu.models.litgpt import Config, GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
     cfg = Config.from_name(model_name, block_size=T)
     model = GPTForCausalLM(cfg)
-    step = TrainStep(model, optim.AdamW(lr=1e-4))
+    # bf16 mixed precision by default, matching the reference harness
+    # (thunder/benchmarks/benchmark_litgpt.py precision default)
+    transforms = []
+    if os.environ.get("BENCH_PRECISION", "bf16") == "bf16":
+        from thunder_tpu.transforms.autocast import AutocastTransform
+
+        transforms.append(AutocastTransform())
+    step = TrainStep(tt.jit(model, transforms=transforms), optim.AdamW(lr=1e-4))
     rng = np.random.RandomState(0)
     idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
     tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)

@@ -273,3 +273,144 @@ _G = 5
 
 def _uses_global(x):
     return x + _G
+
+
+# ---------------------------------------------------------------------------
+# generators / match / class bodies (round-1 widening)
+# ---------------------------------------------------------------------------
+
+
+class TestGenerators:
+    def test_basic_generator(self):
+        def gen(n):
+            total = 0
+            for i in range(n):
+                total += (yield i * 2)
+            return total
+
+        def use():
+            g = gen(3)
+            outs, ret = [], None
+            try:
+                v = next(g)
+                while True:
+                    outs.append(v)
+                    v = g.send(10)
+            except StopIteration as e:
+                ret = e.value
+            return outs, ret
+
+        assert interpret(use) == use()
+
+    def test_generator_expression(self):
+        def f():
+            return sum(i * i for i in range(10) if i % 2)
+
+        assert interpret(f) == f()
+
+    def test_yield_from(self):
+        def f():
+            def inner():
+                yield from (i * i for i in range(4))
+                return "done"
+
+            return list(inner())
+
+        assert interpret(f) == f()
+
+    def test_generator_close_and_bare_raise(self):
+        def f():
+            def g():
+                try:
+                    yield 1
+                    yield 2
+                except GeneratorExit:
+                    raise
+
+            it = g()
+            first = next(it)
+            it.close()
+            return first
+
+        assert interpret(f) == 1
+
+    def test_send_protocol_rejects_nonnull_start(self):
+        def f():
+            def g():
+                yield 1
+
+            it = g()
+            try:
+                it.send(5)
+            except TypeError:
+                return "rejected"
+            return "accepted"
+
+        assert interpret(f) == "rejected"
+
+
+class TestMatchStatements:
+    def test_match_shapes(self):
+        def matcher(x):
+            match x:
+                case {"a": v}:
+                    return ("map", v)
+                case [p, q]:
+                    return ("seq", p + q)
+                case int() as n if n > 3:
+                    return ("big", n)
+                case _:
+                    return ("other", x)
+
+        for arg in ({"a": 7}, [2, 3], 5, "zz"):
+            assert interpret(matcher, arg) == matcher(arg)
+
+    def test_match_class_positional(self):
+        def f():
+            class P:
+                __match_args__ = ("x", "y")
+
+                def __init__(self):
+                    self.x, self.y = 4, 9
+
+            match P():
+                case P(a, b):
+                    return a + b
+            return None
+
+        assert interpret(f) == 13
+
+
+class TestClassBodies:
+    def test_class_definition_in_traced_code(self):
+        def f():
+            class Acc:
+                scale = 3
+
+                def __init__(self, v):
+                    self.v = v
+
+                def doubled(self):
+                    return self.v * 2 * Acc.scale
+
+            return Acc(7).doubled()
+
+        assert interpret(f) == f()
+
+    def test_assert_statement(self):
+        def f(x):
+            assert x > 0, "must be positive"
+            return x + 1
+
+        assert interpret(f, 3) == 4
+        with pytest.raises(AssertionError):
+            interpret(f, -1)
+
+    def test_double_star_kwargs_merge(self):
+        def f():
+            def k(**kw):
+                return sorted(kw.items())
+
+            return k(**{"a": 1}, **{"b": 2})
+
+        assert interpret(f) == f()

@@ -92,12 +92,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, caus
     lse_ref[:] = (m + jnp.log(l_safe))[:, None]
 
 
+def _pad_head_dim(*tensors):
+    """Zero-pad the head dim to the 128-lane multiple (exact for attention:
+    zero q/k pads add nothing to q·kᵀ, zero v pads produce zero output cols
+    that are sliced away)."""
+    D = tensors[0].shape[-1]
+    Dp = -(-D // 128) * 128
+    if Dp == D:
+        return tensors, D
+    pad = [(0, 0)] * (tensors[0].ndim - 1) + [(0, Dp - D)]
+    return tuple(jnp.pad(t, pad) for t in tensors), D
+
+
 def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
                             block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
-    """q,k,v: (B, H, T, D) -> (o, lse). D must be a multiple of 128 (lane dim)."""
+    """q,k,v: (B, H, T, D) -> (o, lse). Any D (zero-padded to the 128 lane dim)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    (q, k, v), D_orig = _pad_head_dim(q, k, v)
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
     grid = (B, H, T // block_q)
@@ -107,13 +120,13 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
                           q_offset_blocks=0),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
@@ -121,6 +134,8 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
         ],
         interpret=_interpret(),
     )(q, k, v)
+    if D_orig != D:
+        o = o[..., :D_orig]
     return o, lse[..., 0]
 
 
@@ -197,9 +212,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
 
 def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=None,
                              block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    (q, k, v, o, do), D_orig = _pad_head_dim(q, k, v, o, do)
     B, H, T, D = q.shape
     Tk = k.shape[2]
-    scale = scale if scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (B,H,T)
@@ -210,14 +226,14 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
         grid=(B, H, T // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_specs=pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
@@ -226,16 +242,16 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
         grid=(B, H, Tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
@@ -243,6 +259,8 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
+    if D_orig != D:
+        dq, dk, dv = dq[..., :D_orig], dk[..., :D_orig], dv[..., :D_orig]
     return dq, dk, dv
 
 
@@ -252,7 +270,7 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=
         return False
     shapes_ok = (
         getattr(q, "ndim", 0) == 4
-        and q.shape[-1] % 128 == 0
+        and q.shape[-1] <= 512  # any head dim (zero-padded to the 128 lane)
         and q.shape[-2] % DEFAULT_BLOCK_Q == 0
         and k.shape[-2] % DEFAULT_BLOCK_K == 0
         and q.shape[-2] == k.shape[-2]

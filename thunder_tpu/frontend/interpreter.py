@@ -168,7 +168,7 @@ def _is_opaque_function(fn: Callable) -> bool:
     if mod.partition(".")[0] in _OPAQUE_MODULE_PREFIXES:
         return True
     code = fn.__code__
-    if code.co_flags & (0x20 | 0x80 | 0x200):  # generator/coroutine/async-gen
+    if code.co_flags & (0x80 | 0x200):  # coroutine/async-gen (generators ARE interpreted)
         return True
     return False
 
@@ -212,6 +212,7 @@ class Frame:
         self.ip = 0
         self.exc_table = _parse_exception_table(code)
         self.block_depths: list[int] = []  # exception handler stack depths
+        self.exc_stack: list[BaseException] = []  # live handlers' exceptions
 
     def push(self, v):
         self.stack.append(v)
@@ -320,6 +321,8 @@ class Interpreter:
                 result = self.step(frame, fn, ins)
             except _Return as r:
                 return r.value
+            except _Yield:
+                raise  # generator suspension, not an exception to handle
             except InterpreterError:
                 raise
             except Exception as e:
@@ -834,13 +837,56 @@ class Interpreter:
         return None
 
     def op_RETURN_GENERATOR(self, frame, fn, ins):
-        raise InterpreterError("generator functions are executed opaquely, not interpreted")
+        # create the interpreter-backed generator; the frame resumes from the
+        # next instruction on first send (reference interpreter.py handles
+        # generators the same way: the frame object IS the generator state)
+        gen = InterpGenerator(self, frame, fn)
+        frame.ip += 1
+        raise _Return(wrap(gen, Provenance("op")))
+
+    def op_YIELD_VALUE(self, frame, fn, ins):
+        value = frame.pop()
+        frame.ip += 1  # resume continues after the yield
+        raise _Yield(value)
+
+    def op_GET_YIELD_FROM_ITER(self, frame, fn, ins):
+        it = unwrap(frame.peek(1))
+        if not (isinstance(it, InterpGenerator) or isinstance(it, types.GeneratorType)):
+            frame.push(wrap(iter(unwrap(frame.pop())), Provenance("op")))
+        return None
+
+    def op_SEND(self, frame, fn, ins):
+        # STACK: [receiver, value]; send value into receiver. On StopIteration
+        # replace value with the result and jump by delta (receiver removed by
+        # END_SEND at the jump target).
+        value = unwrap(frame.pop())
+        receiver = unwrap(frame.peek(1))
+        try:
+            if value is None:
+                res = next(receiver) if hasattr(receiver, "__next__") else receiver.send(None)
+            else:
+                res = receiver.send(value)
+        except StopIteration as e:
+            frame.push(wrap(e.value, Provenance("op")))
+            return ins.argval
+        frame.push(wrap(res, Provenance("op")))
+        return None
+
+    def op_END_SEND(self, frame, fn, ins):
+        value = frame.pop()
+        frame.pop()  # receiver
+        frame.push(value)
+        return None
+
+    def op_JUMP_BACKWARD_NO_INTERRUPT(self, frame, fn, ins):
+        return ins.argval
 
     # ---- exceptions ----
     def op_PUSH_EXC_INFO(self, frame, fn, ins):
         exc = frame.pop()
         frame.push(wrap(None))  # previous exc_info placeholder
         frame.push(exc)
+        frame.exc_stack.append(unwrap(exc))  # current exception, for bare raise
         return None
 
     def op_CHECK_EXC_MATCH(self, frame, fn, ins):
@@ -851,6 +897,8 @@ class Interpreter:
 
     def op_POP_EXCEPT(self, frame, fn, ins):
         frame.pop()
+        if frame.exc_stack:
+            frame.exc_stack.pop()
         return None
 
     def op_RERAISE(self, frame, fn, ins):
@@ -861,6 +909,8 @@ class Interpreter:
 
     def op_RAISE_VARARGS(self, frame, fn, ins):
         if ins.arg == 0:
+            if frame.exc_stack:
+                raise frame.exc_stack[-1]
             raise InterpreterError("bare raise outside exception handler is unsupported")
         if ins.arg == 2:
             cause = unwrap(frame.pop())
@@ -906,6 +956,193 @@ class _Return(Exception):
         self.value = value
 
 
+def _install_extra_opcodes(cls):
+    """Name-space ops, match statements, asserts, class building — the long
+    tail of the reference's 188 opcode handlers (interpreter.py:1257)."""
+
+    def op_STORE_NAME(self, frame, fn, ins):
+        frame.locals[ins.argval] = frame.pop()
+        return None
+
+    def op_DELETE_NAME(self, frame, fn, ins):
+        frame.locals.pop(ins.argval, None)
+        return None
+
+    def op_DELETE_GLOBAL(self, frame, fn, ins):
+        del frame.f_globals[ins.argval]
+        return None
+
+    def op_LOAD_ASSERTION_ERROR(self, frame, fn, ins):
+        frame.push(wrap(AssertionError, Provenance("const")))
+        return None
+
+    def op_EXTENDED_ARG(self, frame, fn, ins):
+        return None  # dis already folds the extended arg into the next instruction
+
+    def op_DICT_MERGE(self, frame, fn, ins):
+        other = unwrap(frame.pop())
+        target = unwrap(frame.peek(ins.arg))
+        for k in other:
+            if k in target:
+                raise TypeError(f"got multiple values for keyword argument {k!r}")
+        target.update(other)
+        return None
+
+    def op_SETUP_ANNOTATIONS(self, frame, fn, ins):
+        if "__annotations__" not in frame.locals:
+            frame.locals["__annotations__"] = wrap({}, Provenance("const"))
+        return None
+
+    def op_LOAD_LOCALS(self, frame, fn, ins):
+        frame.push(wrap({k: unwrap(v) for k, v in frame.locals.items()}, Provenance("op")))
+        return None
+
+    def op_LOAD_BUILD_CLASS(self, frame, fn, ins):
+        frame.push(wrap(builtins.__build_class__, Provenance("const")))
+        return None
+
+    # -- match statements (PEP 634) --
+    def op_MATCH_SEQUENCE(self, frame, fn, ins):
+        import collections.abc as abc
+
+        subject = unwrap(frame.peek(1))
+        ok = isinstance(subject, abc.Sequence) and not isinstance(subject, (str, bytes, bytearray))
+        frame.push(wrap(ok, Provenance("op")))
+        return None
+
+    def op_MATCH_MAPPING(self, frame, fn, ins):
+        import collections.abc as abc
+
+        frame.push(wrap(isinstance(unwrap(frame.peek(1)), abc.Mapping), Provenance("op")))
+        return None
+
+    def op_MATCH_KEYS(self, frame, fn, ins):
+        keys = unwrap(frame.peek(1))
+        subject = unwrap(frame.peek(2))
+        if all(k in subject for k in keys):
+            frame.push(wrap(tuple(subject[k] for k in keys), Provenance("op")))
+        else:
+            frame.push(wrap(None, Provenance("const")))
+        return None
+
+    # builtins where `case cls(x):` binds the subject itself (CPython MATCH_SELF)
+    _MATCH_SELF_TYPES = (bool, bytearray, bytes, dict, float, frozenset, int,
+                         list, set, str, tuple)
+
+    def op_MATCH_CLASS(self, frame, fn, ins):
+        kwd_attrs = unwrap(frame.pop())
+        cls_ = unwrap(frame.pop())
+        subject = unwrap(frame.pop())
+        count = ins.arg
+        if not isinstance(subject, cls_):
+            frame.push(wrap(None, Provenance("const")))
+            return None
+        attrs = []
+        try:
+            if count:
+                if cls_ in _MATCH_SELF_TYPES and not hasattr(cls_, "__match_args__"):
+                    if count != 1:
+                        raise TypeError(f"{cls_.__name__}() accepts 1 positional sub-pattern")
+                    attrs.append(subject)
+                else:
+                    match_args = getattr(cls_, "__match_args__", ())
+                    if len(match_args) < count:
+                        raise TypeError(f"{cls_.__name__}() accepts {len(match_args)} positional sub-patterns")
+                    for i in range(count):
+                        attrs.append(getattr(subject, match_args[i]))
+            for name in kwd_attrs:
+                attrs.append(getattr(subject, name))
+        except AttributeError:
+            frame.push(wrap(None, Provenance("const")))
+            return None
+        frame.push(wrap(tuple(attrs), Provenance("op")))
+        return None
+
+    for name, impl in list(locals().items()):
+        if name.startswith("op_"):
+            setattr(cls, name, impl)
+    return cls
+
+
+class _Yield(Exception):
+    def __init__(self, value: WrappedValue):
+        self.value = value
+
+
+class InterpGenerator:
+    """Interpreter-backed generator: the suspended Frame IS the generator
+    state (reference interpreter.py runs generator frames the same way).
+    Supports iteration, send, throw (delivered through the frame's exception
+    table), and close."""
+
+    def __init__(self, interp: "Interpreter", frame: "Frame", fn):
+        self._interp = interp
+        self._frame = frame
+        self._fn = fn
+        self._started = False
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.send(None)
+
+    def _resume(self):
+        interp, frame = self._interp, self._frame
+        interp.depth += 1
+        try:
+            try:
+                result = interp.run_frame(frame, self._fn)
+            except _Yield as y:
+                return unwrap(y.value)
+            except BaseException:
+                # body raised: the generator is finished (CPython: further
+                # next() raises StopIteration, not a frame re-execution)
+                self._done = True
+                raise
+            self._done = True
+            raise StopIteration(unwrap(result))
+        finally:
+            interp.depth -= 1
+
+    def send(self, value):
+        if self._done:
+            raise StopIteration
+        if not self._started and value is not None:
+            raise TypeError("can't send non-None value to a just-started generator")
+        # CPython pushes the sent value on every resume (the generator body
+        # pops or stores it — the first POP_TOP discards the initial None)
+        self._frame.push(wrap(value, Provenance("op")))
+        self._started = True
+        return self._resume()
+
+    def throw(self, exc, *rest):
+        if isinstance(exc, type):
+            exc = exc(*rest) if rest else exc()
+        if self._done or not self._started:
+            self._done = True
+            raise exc
+        handled = self._interp._handle_exception(self._frame, exc)
+        if not handled:
+            self._done = True
+            raise exc
+        return self._resume()
+
+    def close(self):
+        if self._done or not self._started:
+            self._done = True
+            return
+        try:
+            self.throw(GeneratorExit)
+        except (GeneratorExit, StopIteration):
+            self._done = True
+            return
+        # the generator caught GeneratorExit and yielded again
+        self._done = True
+        raise RuntimeError("generator ignored GeneratorExit")
+
+
 _UNBOUND = WrappedValue(object(), Provenance("const"))  # LOAD_FAST_AND_CLEAR marker
 
 
@@ -913,6 +1150,15 @@ def _bind_args(fn: types.FunctionType, args, kwargs) -> dict[str, Any]:
     """Bind call args to parameter names, keeping WrappedValues; wrap each
     bound arg with 'arg' provenance if it doesn't already carry one."""
     import inspect
+
+    code = fn.__code__
+    if any(n.startswith(".") for n in code.co_varnames[: code.co_argcount]):
+        # genexpr/comprehension code objects take the implicit '.0' iterator
+        # argument, which inspect refuses to name — bind positionally
+        out = {}
+        for name, val in zip(code.co_varnames[: code.co_argcount], args):
+            out[name] = val if isinstance(val, WrappedValue) else wrap(val, Provenance("arg", name))
+        return out
 
     # follow_wrapped=False: we are binding THIS code object's parameters, not
     # the signature functools.wraps advertises
@@ -932,6 +1178,9 @@ def _bind_args(fn: types.FunctionType, args, kwargs) -> dict[str, Any]:
         else:
             out[name] = wrap(val, Provenance("arg", name))
     return out
+
+
+_install_extra_opcodes(Interpreter)
 
 
 def interpret(fn: Callable, *args, lookasides: dict | None = None,

@@ -203,7 +203,8 @@ _CATALOG_NONDIFF: dict[str, Callable] = {
         sorted_seq, values, side="right" if right else "left"),
     "bucketize": lambda values, boundaries, right=False: jnp.searchsorted(
         boundaries, values, side="right" if right else "left"),
-    "bincount": lambda a, weights=None, minlength=0: jnp.bincount(a, weights=weights, length=minlength or None),
+    # torch.bincount's output length depends on max(a) — a dynamic shape XLA
+    # cannot express; intentionally NOT registered (like nonzero/unique)
     "histc": lambda a, bins=100, min=0.0, max=0.0: jnp.histogram(
         a, bins=bins, range=(min, max) if (min or max) else None)[0],
     "isclose": jnp.isclose,
