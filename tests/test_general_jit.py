@@ -166,3 +166,60 @@ class TestSemantics:
 
         cf = tt.jit(f, interpretation="python interpreter")
         np.testing.assert_allclose(np.asarray(cf(x, 3)), np.asarray(x) * 1.5 ** 3, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# SYMBOLIC_VALUES / SAME_INPUT cache options (reference core/options.py:45-49)
+# ---------------------------------------------------------------------------
+
+
+class TestSymbolicValuesCache:
+    def test_unobserved_number_generalizes(self, rng):
+        calls = []
+
+        def f(x, scale):
+            calls.append(1)
+            return ltorch.mul(x, scale)
+
+        cf = tt.jit(f, cache="symbolic values")
+        x = rng.rand(2, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(cf(x, 2.0)), x * 2.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cf(x, 5.0)), x * 5.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cf(x, -1.5)), x * -1.5, atol=1e-6)
+        assert cf.cache_misses == 1 and cf.cache_hits == 2
+
+    def test_observed_number_pins(self, rng):
+        def g(x, n):
+            if n > 0:
+                return ltorch.mul(x, n)
+            return ltorch.sub(x, n)
+
+        cg = tt.jit(g, cache="symbolic values")
+        x = np.ones((2, 2), np.float32)
+        assert float(np.asarray(cg(x, 3.0))[0, 0]) == 3.0
+        assert float(np.asarray(cg(x, -4.0))[0, 0]) == 5.0   # x - (-4)
+        assert float(np.asarray(cg(x, 3.0))[0, 0]) == 3.0    # hits first entry
+        assert cg.cache_misses == 2 and cg.cache_hits == 1
+
+    def test_int_vs_float_distinct_entries(self, rng):
+        def f(x, s):
+            return ltorch.mul(x, s)
+
+        cf = tt.jit(f, cache="symbolic values")
+        x = np.ones((2,), np.float32)
+        cf(x, 2.0)
+        cf(x, 3)     # int: different type key -> new entry
+        cf(x, 4.0)   # float again: hit
+        assert cf.cache_misses == 2 and cf.cache_hits == 1
+
+
+class TestSameInputCache:
+    def test_single_entry_reused(self, rng):
+        def f(x, y):
+            return ltorch.add(x, y)
+
+        cf = tt.jit(f, cache="same input")
+        x = rng.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(cf(x, x)), 2 * x, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cf(x, x)), 2 * x, atol=1e-6)
+        assert cf.cache_misses == 1 and cf.cache_hits == 1

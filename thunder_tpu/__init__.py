@@ -251,13 +251,26 @@ def jit(
         raise ValueError(
             "sharp_edges checking requires the bytecode-interpreter frontend: "
             "pass interpretation='python interpreter'")
+    _is_torch_module = type(fn).__module__.partition(".")[0] == "torch" or any(
+        c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]
+    )
+    if cache in ("symbolic values", "same input"):
+        if isinstance(fn, Module) or _is_torch_module:
+            raise ValueError(
+                f"cache={cache!r} is only supported for plain callables "
+                f"(modules always take tensor inputs; use 'constant values')")
+        # these cache modes live on the prologue machinery of the
+        # interpreter frontend (reference thunder/core/options.py:45-49)
+        from .frontend.compiled import InterpretedFunction
+
+        return InterpretedFunction(fn, executors=executors,
+                                   transforms=transforms or (), cache=cache,
+                                   disable_fusion=disable_fusion, **compile_options)
     if isinstance(fn, Module):
         return ThunderModule(fn, executors=executors, cache=cache, transforms=transforms,
                              disable_fusion=disable_fusion, **compile_options)
     # torch.nn.Module -> __torch_function__ tracing frontend (lazy torch import)
-    if type(fn).__module__.partition(".")[0] == "torch" or any(
-        c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]
-    ):
+    if _is_torch_module:
         from .interop.torch_frontend import compile_torch_module
 
         return compile_torch_module(fn, executors=executors, cache=cache, transforms=transforms,

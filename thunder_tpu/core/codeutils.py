@@ -33,8 +33,9 @@ class ContextInterner:
 def prettyprint(x: Any, interner: ContextInterner) -> str:
     """Render x as a python expression valid inside the generated function."""
     if isinstance(x, NumberProxy):
-        # static numbers print as literals; keeps generated code jit-friendly
-        if x.is_static:
+        # static numbers print as literals; keeps generated code jit-friendly.
+        # symbolic numbers are runtime inputs and print by name.
+        if x.is_static and not getattr(x, "is_symbolic", False):
             return repr(x.value)
         return x.name
     if isinstance(x, CollectionProxy):

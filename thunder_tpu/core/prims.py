@@ -362,7 +362,10 @@ tensor_constant = make_prim(PrimIDs.TENSOR_CONSTANT, "tensor_constant", _tensor_
 
 
 def _full_meta(shape, fill_value, *, device=None, dtype=None):
-    dtype = dtypes.to_dtype(dtype) if dtype is not None else dtypes.to_dtype(type(pyval(fill_value)))
+    from .proxies import pytype
+
+    # pytype, not pyval: a symbolic NumberProxy fill stays a runtime input
+    dtype = dtypes.to_dtype(dtype) if dtype is not None else dtypes.to_dtype(pytype(fill_value))
     device = to_device(device) if device is not None else None
     return TensorProxy(shape=tuple(shape), dtype=dtype, device=device)
 

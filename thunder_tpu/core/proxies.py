@@ -139,6 +139,9 @@ class NumberProxy(Proxy):
         super().__init__(name)
         self.value = value
         self.python_type = python_type or (type(value) if value is not None else float)
+        # symbolic numbers are runtime trace inputs: generated code references
+        # them by name instead of baking the trace-time value
+        self.is_symbolic = False
 
     @property
     def is_static(self) -> bool:
@@ -147,25 +150,31 @@ class NumberProxy(Proxy):
     def type_string(self) -> str:
         return f"{self.python_type.__name__} {self.value}"
 
-    # numbers behave statically in traces
+    def _observed(self):
+        if _number_observe_cb is not None:
+            _number_observe_cb(self)
+        return self.value
+
+    # numbers behave statically in traces (observation pins symbolic numbers)
     def __bool__(self):
         check(self.value is not None, lambda: "cannot branch on a dynamic NumberProxy")
-        return bool(self.value)
+        return bool(self._observed())
 
     def __int__(self):
-        return int(self.value)
+        return int(self._observed())
 
     def __float__(self):
-        return float(self.value)
+        return float(self._observed())
 
     def __index__(self):
-        return int(self.value)
+        return int(self._observed())
 
     def _num_binop(self, other, op, rop=False):
-        ov = other.value if isinstance(other, NumberProxy) else other
+        ov = other._observed() if isinstance(other, NumberProxy) else other
         if self.value is None or ov is None:
             raise NotImplementedError("symbolic number arithmetic not yet supported")
-        return op(ov, self.value) if rop else op(self.value, ov)
+        sv = self._observed()
+        return op(ov, sv) if rop else op(sv, ov)
 
     def __add__(self, o):
         return self._num_binop(o, lambda a, b: a + b)
@@ -223,10 +232,45 @@ class NumberProxy(Proxy):
 
 
 def pyval(x):
-    """Static python value of a number-or-NumberProxy."""
+    """Static python value of a number-or-NumberProxy.
+
+    Under symbolic-values tracing, reading the value *pins* the proxy: the
+    prologue will then guard the exact value (reference CONSTRAINT machinery,
+    thunder/core/proxies.py:668 — observation specializes the cache entry)."""
     if isinstance(x, NumberProxy):
+        if _number_observe_cb is not None:
+            _number_observe_cb(x)
         return x.value
     return x
+
+
+def pytype(x) -> type:
+    """Python type of a number-or-NumberProxy WITHOUT pinning it."""
+    if isinstance(x, NumberProxy):
+        return x.python_type
+    return type(x)
+
+
+_number_observe_cb = None
+
+
+class number_observation:
+    """Context manager installing a callback fired whenever a NumberProxy's
+    concrete value is observed (pyval/bool/int/float/arithmetic)."""
+
+    def __init__(self, cb):
+        self.cb = cb
+
+    def __enter__(self):
+        global _number_observe_cb
+        self._prev = _number_observe_cb
+        _number_observe_cb = self.cb
+        return self
+
+    def __exit__(self, *exc):
+        global _number_observe_cb
+        _number_observe_cb = self._prev
+        return False
 
 
 class StringProxy(Proxy):

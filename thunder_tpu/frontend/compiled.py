@@ -35,10 +35,10 @@ class InterpretedFunction:
                  transforms: Sequence = (), lookasides: dict | None = None,
                  cache: str = "constant values", disable_fusion: bool = False,
                  **compile_options):
-        if cache not in ("constant values", "no caching"):
+        if cache not in ("constant values", "no caching", "symbolic values", "same input"):
             raise ValueError(
                 f"cache={cache!r} is not supported by the interpreter frontend "
-                f"(supported: 'constant values', 'no caching')")
+                f"(supported: 'constant values', 'no caching', 'symbolic values', 'same input')")
         self.fn = fn
         self.executors = executors
         self.sharp_edges = sharp_edges
@@ -51,16 +51,22 @@ class InterpretedFunction:
         self.__name__ = getattr(fn, "__name__", type(fn).__name__)
 
     def _shape_key(self, leaves, mask):
+        symbolic = self.cache_option == "symbolic values"
         key = []
         for leaf, is_t in zip(leaves, mask):
             if is_t:
                 key.append(("T", tuple(leaf.shape), str(leaf.dtype)))
+            elif symbolic and isinstance(leaf, (int, float)) and not isinstance(leaf, bool):
+                # symbolic numbers cache by type; the prologue value-guards
+                # only the pinned (observed) ones
+                key.append(("N", type(leaf).__name__))
             else:
                 try:
                     hash(leaf)
-                    key.append(("S", leaf))
+                    # type name disambiguates 2 / 2.0 / True, which hash equal
+                    key.append(("S", type(leaf).__name__, leaf))
                 except TypeError:
-                    key.append(("S", repr(leaf)))
+                    key.append(("S", type(leaf).__name__, repr(leaf)))
         return tuple(key)
 
     def _compile(self, args, kwargs, shape_key) -> InterpretedEntry:
@@ -71,7 +77,8 @@ class InterpretedFunction:
         t0 = time.perf_counter_ns()
         res, treedef, mask, leaves = general_jit(self.fn, args, kwargs,
                                                  sharp_edges=self.sharp_edges,
-                                                 lookasides=self.lookasides)
+                                                 lookasides=self.lookasides,
+                                                 symbolic_numbers=self.cache_option == "symbolic values")
         cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
         t1 = time.perf_counter_ns()
@@ -105,8 +112,20 @@ class InterpretedFunction:
         cs.calls += 1
         leaves, _ = tree_flatten((args, kwargs))
         mask = [_is_tensor_like(l) for l in leaves]
+        if self.cache_option == "same input" and self._entries:
+            # reuse the sole entry unconditionally (reference SAME_INPUT:
+            # the caller asserts inputs never change shape/type)
+            entry = self._entries[0]
+            cs.cache_hits += 1
+            tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
+            return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
         shape_key = self._shape_key(leaves, mask)
         tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
+        if self.cache_option == "symbolic values":
+            # the prologue takes the runtime numbers after the tensors
+            tensor_leaves = tensor_leaves + [
+                l for l, m in zip(leaves, mask)
+                if not m and isinstance(l, (int, float)) and not isinstance(l, bool)]
         if self.cache_option == "no caching":
             entry = self._compile(args, kwargs, shape_key)
             self._entries.clear()

@@ -132,23 +132,42 @@ class GeneralJitCtx:
 
 
 def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
-                lookasides: dict | None = None) -> tuple[JitResults, Any, list, list]:
+                lookasides: dict | None = None,
+                symbolic_numbers: bool = False) -> tuple[JitResults, Any, list, list]:
     """Interpret fn over proxies, producing prologue + computation traces.
 
     Returns (JitResults, treedef, tensor_mask, leaves) — same surface as
-    thunder_tpu.acquire_trace plus the prologue."""
+    thunder_tpu.acquire_trace plus the prologue.
+
+    symbolic_numbers: number arguments become NumberProxy runtime inputs
+    (SYMBOLIC_VALUES cache semantics). A number whose concrete value the
+    traced program *observes* (branching, arithmetic, pyval) is pinned and
+    value-guarded in the prologue; unobserved numbers generalize across calls
+    (reference thunder/core/options.py:45-49 + constraint propagation)."""
+    import contextlib
+
+    from ..core.proxies import NumberProxy, number_observation
+
     leaves, treedef = tree_flatten((args, kwargs))
     trc = TraceCtx(fn)
     ctx = GeneralJitCtx(trc, sharp_edges=sharp_edges)
 
     proxy_leaves = []
     tensor_mask = []
+    number_proxies: list[NumberProxy] = []
+    pinned: set[str] = set()
     with tracectx(trc):
         for leaf in leaves:
             if _is_tensor_like(leaf):
                 p = proxy_from_jax(leaf, requires_grad=bool(getattr(leaf, "requires_grad", False)))
                 proxy_leaves.append(p)
                 tensor_mask.append(True)
+            elif symbolic_numbers and isinstance(leaf, (int, float)) and not isinstance(leaf, bool):
+                np_ = NumberProxy(leaf, type(leaf))
+                np_.is_symbolic = True
+                proxy_leaves.append(np_)
+                number_proxies.append(np_)
+                tensor_mask.append(False)
             else:
                 proxy_leaves.append(leaf)
                 tensor_mask.append(False)
@@ -158,20 +177,24 @@ def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
         interp = Interpreter(lookasides=lookasides,
                              on_provenance_load=ctx.on_provenance_load,
                              on_sharp_edge=ctx.on_sharp_edge)
-        result = unwrap(interp.call(
-            wrap(fn),
-            [wrap(a, Provenance("arg", i)) for i, a in enumerate(pargs)],
-            {k: wrap(v, Provenance("arg", k)) for k, v in pkwargs.items()},
-        ))
+        observe_ctx = (number_observation(lambda p: pinned.add(p.name))
+                       if symbolic_numbers else contextlib.nullcontext())
+        with observe_ctx:
+            result = unwrap(interp.call(
+                wrap(fn),
+                [wrap(a, Provenance("arg", i)) for i, a in enumerate(pargs)],
+                {k: wrap(v, Provenance("arg", k)) for k, v in pkwargs.items()},
+            ))
         prims.python_return(result)
-    trc.args = arg_proxies + tuple(c.proxy for c in ctx.captured)
+    trc.args = arg_proxies + tuple(number_proxies) + tuple(c.proxy for c in ctx.captured)
 
-    pro = _build_prologue(fn, arg_proxies, ctx)
+    pro = _build_prologue(fn, arg_proxies, ctx, number_proxies=number_proxies, pinned=pinned)
     res = JitResults(pro, trc, ctx.captured, ctx.sharp_edges)
     return res, treedef, tensor_mask, leaves
 
 
-def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: GeneralJitCtx) -> TraceCtx:
+def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: GeneralJitCtx,
+                    *, number_proxies: Sequence = (), pinned: frozenset = frozenset()) -> TraceCtx:
     """Prologue trace: validate args, re-extract + validate captured values.
 
     Signature: prologue(*tensor_args) -> (*tensor_args, *captured_tensors);
@@ -184,13 +207,25 @@ def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: Gener
         "attr": prims.unpack_attr,
         "item": prims.unpack_item,
     }
+    from ..core.proxies import NumberProxy
+
     with tracectx(pro):
         qargs = []
         for p in arg_proxies:
             q = TensorProxy(p.name, shape=p.shape, dtype=p.dtype, device=p.device)
             qargs.append(q)
             prims.check_tensor_shape_and_metadata(q, p.shape, p.dtype, str(p.device))
-        pro.args = tuple(qargs)
+        qnums = []
+        for np_ in number_proxies:
+            qn = NumberProxy(np_.value, np_.python_type, name=np_.name)
+            qn.is_symbolic = True
+            pro.add_name(qn.name)
+            qnums.append(qn)
+            # pinned (observed) numbers guard the exact value; unobserved
+            # numbers guard only the python type and generalize across calls
+            prims.check_number_type_and_value(
+                qn, np_.python_type, np_.value if np_.name in pinned else None)
+        pro.args = tuple(qargs) + tuple(qnums)
 
         # emit unpack chains, sharing intermediate objects across captures
         emitted: dict[tuple, Proxy] = {}
@@ -231,5 +266,5 @@ def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: Gener
             v = emit_chain(chk.provenance, None)
             prims.check_number_type_and_value(v, type(chk.value), chk.value)
 
-        prims.python_return(tuple(qargs) + tuple(cap_outs))
+        prims.python_return(tuple(qargs) + tuple(qnums) + tuple(cap_outs))
     return pro

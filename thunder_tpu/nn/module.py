@@ -187,6 +187,10 @@ class ThunderModule:
                  disable_fusion=False, **compile_options):
         from .. import jit as _jit
 
+        if cache not in ("constant values", "no caching"):
+            raise ValueError(
+                f"cache={cache!r} is not supported for modules "
+                f"(supported: 'constant values', 'no caching')")
         self._module = module
         self._overrides: dict = {}
 

@@ -130,10 +130,12 @@ def _elementwise_binary(prim, a, b, *, int_to_float=False, bool_out=False):
             b = maybe_convert_to_dtype(b, dt)
     if not isinstance(a, TensorProxy) and not isinstance(b, TensorProxy):
         raise NotImplementedError("number-number ops should be computed statically")
+    # NumberProxy operands stay runtime inputs to full (symbolic caching);
+    # plain numbers are baked as before
     if not isinstance(a, TensorProxy):
-        a = full_like(b, pyval(a), dtype=dt if not bool_out else None)
+        a = full_like(b, a if isinstance(a, NumberProxy) else pyval(a), dtype=dt if not bool_out else None)
     if not isinstance(b, TensorProxy):
-        b = full_like(a, pyval(b), dtype=dt if not bool_out else None)
+        b = full_like(a, b if isinstance(b, NumberProxy) else pyval(b), dtype=dt if not bool_out else None)
     return prim(a, b)
 
 
