@@ -1,0 +1,87 @@
+"""no_sync gradient accumulation (reference ThunderModule.no_sync,
+thunder/core/module.py:341 + skip_data_parallel_grad_sync)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+from thunder_tpu.training import TrainStep
+
+
+class _Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4, seed=0)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc(x), y)
+
+
+def _batches(rng, n=3):
+    return [(jnp.asarray(rng.rand(4, 8).astype(np.float32)),
+             jnp.asarray(rng.rand(4, 4).astype(np.float32))) for _ in range(n)]
+
+
+def test_no_sync_defers_update(rng):
+    net = _Net()
+    tm = tt.jit(net)
+    step = TrainStep(tm, optim.AdamW(lr=0.1))
+    batches = _batches(rng)
+    w0 = np.asarray(net.fc.weight.data).copy()
+    with tm.no_sync():
+        step(*batches[0])
+        step(*batches[1])
+    # params untouched while accumulating
+    np.testing.assert_array_equal(w0, np.asarray(net.fc.weight.data))
+    step(*batches[2])
+    assert not np.array_equal(w0, np.asarray(net.fc.weight.data))
+
+
+def test_accumulated_equals_summed_grads(rng):
+    """K micro steps + 1 sync step == one update with the summed grads."""
+    batches = _batches(rng)
+
+    net_a = _Net()
+    tm_a = tt.jit(net_a)
+    step_a = TrainStep(tm_a, optim.AdamW(lr=0.05))
+    with tm_a.no_sync():
+        step_a(*batches[0])
+        step_a(*batches[1])
+    step_a(*batches[2])
+
+    # manual: sum the three grads, single AdamW update on identical init
+    net_b = _Net()
+
+    def loss_fn(w, b, x, y):
+        return jnp.mean((x @ w.T + b - y) ** 2)
+
+    w = jnp.asarray(net_b.fc.weight.data)
+    b = jnp.asarray(net_b.fc.bias.data)
+    gw = jnp.zeros_like(w)
+    gb = jnp.zeros_like(b)
+    for x, y in batches:
+        dw, db = jax.grad(loss_fn, argnums=(0, 1))(w, b, x, y)
+        gw += dw
+        gb += db
+    opt = optim.AdamW(lr=0.05)
+    params = {"fc.weight": w, "fc.bias": b}
+    state = opt.init(params)
+    new_params, _ = opt.update(params, {"fc.weight": gw, "fc.bias": gb}, state)
+
+    np.testing.assert_allclose(np.asarray(net_a.fc.weight.data),
+                               np.asarray(new_params["fc.weight"]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(net_a.fc.bias.data),
+                               np.asarray(new_params["fc.bias"]), atol=1e-5)
+
+
+def test_micro_step_rejects_distributed_plan(rng):
+    net = _Net()
+    tm = tt.jit(net)
+    tm._dist_plan = object()  # stand-in: any plan triggers the guard
+    step = TrainStep(tm, optim.AdamW(lr=0.1))
+    with pytest.raises(NotImplementedError):
+        with tm.no_sync():
+            step(jnp.zeros((4, 8), jnp.float32), jnp.zeros((4, 4), jnp.float32))

@@ -1,0 +1,37 @@
+"""Execution namespace for printed traces.
+
+A printed trace (``TraceCtx.python()``) references ops by module-qualified
+name (``prims.add``, ``ltorch.softmax``, ``clang.reshape``) plus interned
+constants. With this namespace the printed source is directly executable:
+outside a trace context every Symbol call takes the eager escape hatch
+(core/symbol.py:71) and runs through the default jax executor. This is what
+makes saved reproducer scripts standalone (utils/report.py — the analog of
+reference thunder/dynamo/report.py repro generation)."""
+from __future__ import annotations
+
+from typing import Any
+
+
+def make_trace_namespace() -> dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from . import dtypes, devices, prims
+    from ..ops import clang, ltorch
+
+    ns: dict[str, Any] = {
+        "prims": prims,
+        "ltorch": ltorch,
+        "clang": clang,
+        "dtypes": dtypes,
+        "devices": devices,
+        "jax": jax,
+        "jnp": jnp,
+    }
+    try:
+        from ..parallel import prims as dist_prims
+
+        ns["dist_prims"] = dist_prims
+    except Exception:
+        pass
+    return ns

@@ -207,6 +207,17 @@ class ThunderModule:
         self._cfn = _jit(_traced, executors=executors, cache=cache,
                          transforms=transforms, disable_fusion=disable_fusion, **compile_options)
 
+    @contextmanager
+    def no_sync(self):
+        """Inside this context a TrainStep over this module accumulates local
+        gradients without cross-replica sync or optimizer update (reference
+        ThunderModule.no_sync, thunder/core/module.py:341)."""
+        self._no_sync_active = True
+        try:
+            yield
+        finally:
+            self._no_sync_active = False
+
     @property
     def module(self) -> Module:
         return self._module

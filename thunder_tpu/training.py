@@ -106,6 +106,8 @@ class TrainStep:
         return trainable, frozen
 
     def __call__(self, *args, **kwargs):
+        if getattr(self.tmodule, "_no_sync_active", False):
+            return self.micro_step(*args, **kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
         frozen_arrays = {k: p.data for k, p in frozen.items()}
@@ -113,11 +115,67 @@ class TrainStep:
             self.opt_state = self.optimizer.init(tparam_arrays)
         if self._jitted is None:
             self._build(args, kwargs)
-        loss, new_params, self.opt_state = self._jitted(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+        if self._grad_acc is not None:
+            # final (syncing) step of a no_sync accumulation window: fold the
+            # accumulated local grads in before the optimizer update
+            loss, new_params, self.opt_state = self._jitted_with_acc(
+                tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
+            self._grad_acc = None
+        else:
+            loss, new_params, self.opt_state = self._jitted(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
         for k, p in trainable.items():
             p.data = new_params[k]
         self._step_count += 1
         return loss
+
+    # -- gradient accumulation (reference ThunderModule.no_sync,
+    # thunder/core/module.py:341 + skip_data_parallel_grad_sync) --
+    _grad_acc = None
+    _micro_jitted = None
+    _jitted_with_acc_fn = None
+
+    def micro_step(self, *args, **kwargs):
+        """Accumulate local gradients without the cross-replica sync or the
+        optimizer update; a following regular step folds them in."""
+        if getattr(self.tmodule, "_dist_plan", None) is not None:
+            raise NotImplementedError(
+                "no_sync/micro_step under a distributed plan needs a "
+                "collective-free program variant (planned); accumulate on the "
+                "single-program path or sync every step")
+        trainable, frozen = self._split_params()
+        tparam_arrays = {k: p.data for k, p in trainable.items()}
+        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        if self._jitted is None:
+            if self.opt_state is None:
+                self.opt_state = self.optimizer.init(tparam_arrays)
+            self._build(args, kwargs)
+        if self._micro_jitted is None:
+            vag = self._vag
+
+            def micro(tparam_arrays, frozen_arrays, acc, args, kwargs):
+                loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
+                g = grads[0][0]
+                new_acc = g if acc is None else {k: acc[k] + g[k] for k in g}
+                return loss, new_acc
+
+            self._micro_jitted = jax.jit(micro, donate_argnums=(2,) if self.donate else ())
+        loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
+        return loss
+
+    def _jitted_with_acc(self, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
+        if self._jitted_with_acc_fn is None:
+            vag = self._vag
+            optimizer = self.optimizer
+
+            def step_acc(tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
+                loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
+                g = grads[0][0]
+                total = {k: g[k] + acc[k] for k in g}
+                new_params, new_state = optimizer.update(tparam_arrays, total, opt_state)
+                return loss, new_params, new_state
+
+            self._jitted_with_acc_fn = jax.jit(step_acc, donate_argnums=(0, 2, 3) if self.donate else ())
+        return self._jitted_with_acc_fn(tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs)
 
     @property
     def compile_stats(self):

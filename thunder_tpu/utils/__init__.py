@@ -1,4 +1,6 @@
+from . import examine as examine_mod
 from .check_trace import CheckedListOfTraces, TraceCheckError, check_trace
 from .debug import DebugTransform, ProfileTransform, benchmark_n
-from .examine import examine, get_fusion_source, get_fusions
+from .examine import examine, get_fusion_source, get_fusions, get_xla_repro, to_dot
 from .memory import get_alloc_memory, tensor_bytes
+from .report import profile_report, save_reproducer, timing_report

@@ -69,3 +69,54 @@ def get_fusion_source(cfn, index: int = 0) -> str:
     """Printable subtrace of the index-th fusion (nvfuser-repro analog)."""
     fusions = get_fusions(cfn)
     return fusions[index].impl.subtrace.python()
+
+
+def get_xla_repro(cfn, index: int = 0) -> str:
+    """StableHLO text of the index-th fusion region (the analog of reference
+    get_nvfuser_repro, thunder/examine/__init__.py:257)."""
+    import jax
+
+    fusions = get_fusions(cfn)
+    if not fusions:
+        raise ValueError("no fusion regions in the last trace")
+    bsym = fusions[index]
+    impl = bsym.impl
+    subtrace = getattr(impl, "subtrace", None)
+    jfn = getattr(impl, "jitted", None)
+    if jfn is None or subtrace is None:
+        raise ValueError(f"fusion {index} carries no jitted callable")
+    specs = []
+    for p in subtrace.args:
+        from ..core.dtypes import to_jax_dtype
+
+        specs.append(jax.ShapeDtypeStruct(tuple(p.shape), to_jax_dtype(p.dtype))
+                     if hasattr(p, "shape") else p.value)
+    return jfn.lower(*specs).as_text()
+
+
+def to_dot(trace) -> str:
+    """Graphviz DOT of a trace's dataflow (reference graphviz rendering,
+    thunder/examine/__init__.py:312). Render with `dot -Tsvg`."""
+    from ..core.proxies import Proxy
+
+    lines = ["digraph trace {", "  rankdir=TB;", "  node [shape=box, fontsize=10];"]
+    producer: dict[str, str] = {}
+    declared_args: set[str] = set()
+    for i, bsym in enumerate(trace.bound_symbols):
+        nid = f"n{i}"
+        label = bsym.sym.name.replace('"', "'")
+        lines.append(f'  {nid} [label="{label}"];')
+        for p in bsym.flat_proxy_args():
+            src = producer.get(p.name)
+            if src is not None:
+                lines.append(f'  {src} -> {nid} [label="{p.name}", fontsize=8];')
+            else:
+                argid = f"arg_{p.name}"
+                if argid not in declared_args:
+                    declared_args.add(argid)
+                    lines.append(f'  {argid} [label="{p.name}", shape=ellipse, style=dashed];')
+                lines.append(f"  {argid} -> {nid};")
+        for p in bsym.flat_proxy_outs():
+            producer[p.name] = nid
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,225 @@
+"""Reproducer and timing reports — the analog of ThunderFX's report tooling
+(reference thunder/dynamo/report.py: per-graph repro script generation,
+timing comparisons vs eager/inductor; thunder/dynamo/compiler.py:331
+thunder_profile).
+
+On this stack a "graph" is a compiled cache entry; reproducers serialize the
+final computation trace (which is executable Python over jax) together with
+the input specs, and timing compares the fused program against op-by-op
+dispatch of the same trace."""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _last_trace(cfn, *, executable: bool = False):
+    from .. import last_traces
+
+    traces = last_traces(cfn)
+    if not traces:
+        raise ValueError("no compiled entries yet — call the function first")
+    if executable:
+        # reproducers need symbol-level ops (executable eagerly); fusion
+        # regions hold compiled closures the printed form cannot carry
+        for trc in reversed(traces):
+            if not any(getattr(b.sym, "module", None) == "xla" for b in trc.bound_symbols):
+                return trc
+        raise ValueError("every recorded trace contains fusion regions; "
+                         "no symbol-level trace available for a reproducer")
+    return traces[-1]
+
+
+def _input_specs(trace) -> list[tuple]:
+    from ..core.proxies import NumberProxy, TensorProxy
+
+    specs = []
+    for p in trace.args:
+        if isinstance(p, TensorProxy):
+            specs.append((p.name, tuple(p.shape), p.dtype.name))
+        elif isinstance(p, NumberProxy):
+            specs.append((p.name, None, p.python_type.__name__))
+        else:
+            raise ValueError(
+                f"cannot build a reproducer: trace arg {p!r} is neither a "
+                f"tensor nor a number proxy")
+    return specs
+
+
+def _printed_with_ctx(trace) -> tuple[str, dict]:
+    from ..core.codeutils import ContextInterner
+
+    interner = ContextInterner()
+    lines, _ = trace._build_lines(interner)
+    sig = ", ".join(p.name for p in trace.args)
+    src = f"def {trace.name_of_fn()}({sig}):\n" + "\n".join(f"  {ln}" for ln in lines or ["pass"])
+    return src, dict(interner.ctx)
+
+
+def save_reproducer(cfn, path: str) -> str:
+    """Write a standalone python script reproducing the compiled computation
+    (reference report.py reproducer scripts). The printed trace executes
+    eagerly through the default executor (core/trace_exec.py); interned
+    dtype/device constants are reconstructed, array constants are saved in a
+    sidecar .npz next to the script."""
+    import numpy as np
+
+    from ..core import devices as _devices, dtypes as _dtypes
+
+    trace = _last_trace(cfn, executable=True)
+    src, ctx = _printed_with_ctx(trace)
+    specs = _input_specs(trace)
+    name = trace.name_of_fn()
+
+    const_lines = []
+    arrays: dict[str, Any] = {}
+    for k, v in ctx.items():
+        if isinstance(v, _dtypes.dtype):
+            const_lines.append(f"{k} = thunder_tpu.core.dtypes.to_dtype({v.name!r})")
+        elif isinstance(v, _devices.Device):
+            const_lines.append(f"{k} = thunder_tpu.core.devices.to_device({str(v)!r})")
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            arrays[k] = np.asarray(v)
+            const_lines.append(f"{k} = jnp.asarray(_DATA[{k!r}])")
+        elif isinstance(v, (int, float, bool, str, tuple, list, type(None))):
+            const_lines.append(f"{k} = {v!r}")
+        else:
+            const_lines.append(f"{k} = None  # unserializable: {type(v).__name__}")
+
+    npz_path = path + ".npz"
+    if arrays:
+        np.savez(npz_path, **arrays)
+
+    lines = [
+        '"""thunder_tpu reproducer — auto-generated (utils/report.py).',
+        "",
+        f"fn: {getattr(cfn, '__name__', str(cfn))}",
+        f"trace: {name}",
+        '"""',
+        "import numpy as np",
+        "import jax",
+        "import jax.numpy as jnp",
+        "",
+        "import thunder_tpu",
+        "import thunder_tpu.core.dtypes",
+        "import thunder_tpu.core.devices",
+        "from thunder_tpu.core.trace_exec import make_trace_namespace",
+        "",
+        "import os as _os",
+        f"_DATA = (np.load(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "
+        f"{os.path.basename(npz_path)!r})) if {bool(arrays)} else None)",
+        "",
+        "SRC = " + repr(src),
+        "",
+        "INPUT_SPECS = " + repr(specs),
+        "",
+        "",
+        "def make_inputs(seed=0):",
+        "    rng = np.random.RandomState(seed)",
+        "    out = []",
+        "    for name, shape, dtype in INPUT_SPECS:",
+        "        if shape is None:",
+        "            out.append({'int': 1, 'bool': True}.get(dtype, 0.5))",
+        "        elif dtype.startswith('int') or dtype.startswith('uint'):",
+        "            out.append(jnp.asarray(rng.randint(0, 10, shape), 'int32'))",
+        "        elif dtype == 'bool8':",
+        "            out.append(jnp.asarray(rng.rand(*shape) > 0.5))",
+        "        else:",
+        "            out.append(jnp.asarray(rng.randn(*shape), dtype))",
+        "    return out",
+        "",
+        "",
+        "ns = make_trace_namespace()",
+    ]
+    lines += const_lines and ["# interned constants"] + const_lines or []
+    lines += [
+        "for _k in dir():",
+        "    if _k.startswith('_dtype') or _k.startswith('_dev') or _k.startswith('_c') or _k.startswith('_obj'):",
+        "        ns[_k] = globals()[_k]",
+        "",
+        "if __name__ == '__main__':",
+        "    exec(compile(SRC, 'repro', 'exec'), ns)",
+        f"    fn = ns[{name!r}]",
+        "    outs = fn(*make_inputs())",
+        "    print(jax.tree_util.tree_map(lambda t: getattr(t, 'shape', t), outs))",
+    ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def opbyop_callable(cfn):
+    """Eager op-by-op executable of the compiled function's symbol-level trace
+    (every op dispatches separately through the default executor — the
+    'eager' baseline)."""
+    from ..core.trace_exec import make_trace_namespace
+
+    trace = _last_trace(cfn, executable=True)
+    src, ctx = _printed_with_ctx(trace)
+    ns = make_trace_namespace()
+    ns.update(ctx)
+    exec(compile(src, "<opbyop>", "exec"), ns)
+    return ns[trace.name_of_fn()], trace
+
+
+def timing_report(cfn, *args, iters: int = 10, warmup: int = 2,
+                  compare_opbyop: bool = True, **kwargs) -> dict:
+    """Compare the compiled function against op-by-op execution of the same
+    trace (reference report.py timing tables vs eager)."""
+    out = cfn(*args, **kwargs)  # ensure compiled
+    for _ in range(warmup):
+        out = cfn(*args, **kwargs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = cfn(*args, **kwargs)
+    jax.block_until_ready(out)
+    fused_s = (time.perf_counter() - t0) / iters
+
+    report = {
+        "fused_ms": fused_s * 1e3,
+        "iters": iters,
+    }
+
+    if compare_opbyop:
+        try:
+            eager_fn, trace = opbyop_callable(cfn)
+            flat = [a for a in args] + [kwargs[k] for k in kwargs]
+            tensorish = [a for a in flat if hasattr(a, "shape") or isinstance(a, (int, float))]
+            n_eager = max(1, min(iters, 3))
+            eager_out = eager_fn(*tensorish[: len(trace.args)])
+            jax.block_until_ready(eager_out)
+            t1 = time.perf_counter()
+            for _ in range(n_eager):
+                eager_out = eager_fn(*tensorish[: len(trace.args)])
+            jax.block_until_ready(eager_out)
+            eager_s = (time.perf_counter() - t1) / n_eager
+            report["opbyop_ms"] = eager_s * 1e3
+            report["speedup_vs_opbyop"] = eager_s / fused_s if fused_s else None
+        except Exception as e:  # comparison is best-effort (e.g. captured args)
+            report["opbyop_error"] = str(e)[:200]
+
+    cs = getattr(cfn, "_cs", None)
+    if cs is not None:
+        for attr in ("last_trace_tracing_time_ns", "last_trace_transform_time_ns", "last_compile_time_ns"):
+            v = getattr(cs, attr, None)
+            if v:
+                report[attr.replace("last_", "").replace("_ns", "_ms")] = v / 1e6
+        report["cache_hits"] = getattr(cs, "cache_hits", None)
+        report["cache_misses"] = getattr(cs, "cache_misses", None)
+    return report
+
+
+def profile_report(cfn, *args, trace_dir: Optional[str] = None, **kwargs) -> str:
+    """Run one call under jax.profiler and return the trace directory
+    (open with tensorboard / xprof; reference NvtxProfileTransform's role,
+    thunder/dev_utils/nvtx_profile_transform.py:41)."""
+    trace_dir = trace_dir or os.path.join("/tmp", f"thunder_tpu_profile_{os.getpid()}")
+    with jax.profiler.trace(trace_dir):
+        out = cfn(*args, **kwargs)
+        jax.block_until_ready(out)
+    return trace_dir
