@@ -77,10 +77,40 @@ def test_accumulated_equals_summed_grads(rng):
                                np.asarray(new_params["fc.bias"]), atol=1e-5)
 
 
-def test_micro_step_rejects_distributed_plan(rng):
+def test_ddp_no_sync_matches_single_device(rng):
+    """Distributed (pure-DDP) no_sync accumulation == single-device result."""
+    from thunder_tpu.parallel import ddp, make_mesh
+
+    batches = _batches(rng)
+
+    net_a = _Net()
+    tm_a = tt.jit(net_a)
+    ddp(tm_a, make_mesh({"dp": 4}))
+    step_a = TrainStep(tm_a, optim.AdamW(lr=0.05))
+    with tm_a.no_sync():
+        step_a(*batches[0])
+        step_a(*batches[1])
+    step_a(*batches[2])
+
+    net_b = _Net()
+    step_b = TrainStep(tt.jit(net_b), optim.AdamW(lr=0.05))
+    tm_b = step_b.tmodule
+    with tm_b.no_sync():
+        step_b(*batches[0])
+        step_b(*batches[1])
+    step_b(*batches[2])
+
+    np.testing.assert_allclose(np.asarray(net_a.fc.weight.data),
+                               np.asarray(net_b.fc.weight.data), atol=1e-5)
+
+
+def test_fsdp_no_sync_rejected(rng):
+    """FSDP grads reduce-scatter per micro-batch; no_sync must refuse."""
+    from thunder_tpu.parallel import fsdp, make_mesh
+
     net = _Net()
     tm = tt.jit(net)
-    tm._dist_plan = object()  # stand-in: any plan triggers the guard
+    fsdp(tm, make_mesh({"fsdp": 4}), min_shard_numel=1)
     step = TrainStep(tm, optim.AdamW(lr=0.1))
     with pytest.raises(NotImplementedError):
         with tm.no_sync():

@@ -62,6 +62,15 @@ class DistPlan:
         return tuple(self.data_axes) + tuple(a for a in self.seq_axes if a not in self.data_axes)
 
     @property
+    def loss_axis_name(self):
+        """Mesh axis name(s) for loss/grad collectives (str for one axis,
+        tuple for several). Raises for plans with no data/seq axes."""
+        axes = self.loss_axes
+        if not axes:
+            raise ValueError("plan has no data/sequence axes — nothing to sync over")
+        return axes if len(axes) > 1 else axes[0]
+
+    @property
     def loss_world_size(self) -> int:
         n = 1
         for a in self.loss_axes:

@@ -39,12 +39,14 @@ class TrainStep:
         self.opt_state = None
         self._step_count = 0
 
-    def _build(self, batch_args, batch_kwargs):
+    def _make_vag(self, *, sync_loss: bool = True):
+        """Build a ThunderValueAndGrad over the (optionally distributed)
+        traced step. sync_loss=False skips the cross-replica loss all-reduce,
+        so gradients stay per-replica partial — the no_sync program variant."""
         from .transforms.autodiff import ThunderValueAndGrad
 
         plan = getattr(self.tmodule, "_dist_plan", None)
         inner = self.tmodule._cfn._cd.fn
-        optimizer = self.optimizer
 
         if plan is None:
             traced = inner
@@ -66,7 +68,7 @@ class TrainStep:
                 full_params = apply_param_collectives(params, plan)
                 with cp_ctx:
                     local_loss = inner(full_params, args, kwargs)
-                if plan.loss_axes:
+                if sync_loss and plan.loss_axes:
                     s = dist_prims.all_reduce(local_loss, plan.loss_axes)
                     return ltorch.div(s, float(plan.loss_world_size))
                 return local_loss
@@ -83,7 +85,12 @@ class TrainStep:
         # argnums=0: the trainable params dict is arg 0 of the traced wrapper;
         # inside the jitted step params are raw arrays, so positional marking
         # is required
-        vag = ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
+        return ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
+
+    def _build(self, batch_args, batch_kwargs):
+        plan = getattr(self.tmodule, "_dist_plan", None)
+        optimizer = self.optimizer
+        vag = self._make_vag(sync_loss=True)
         self._vag = vag
 
         def raw_step(tparam_arrays: dict, frozen_arrays: dict, opt_state, args, kwargs):
@@ -118,8 +125,13 @@ class TrainStep:
         if self._grad_acc is not None:
             # final (syncing) step of a no_sync accumulation window: fold the
             # accumulated local grads in before the optimizer update
-            loss, new_params, self.opt_state = self._jitted_with_acc(
-                tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
+            plan = getattr(self.tmodule, "_dist_plan", None)
+            if plan is not None:
+                loss, new_params, self.opt_state = self._fold_dist(
+                    plan, tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
+            else:
+                loss, new_params, self.opt_state = self._jitted_with_acc(
+                    tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
             self._grad_acc = None
         else:
             loss, new_params, self.opt_state = self._jitted(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
@@ -136,12 +148,15 @@ class TrainStep:
 
     def micro_step(self, *args, **kwargs):
         """Accumulate local gradients without the cross-replica sync or the
-        optimizer update; a following regular step folds them in."""
-        if getattr(self.tmodule, "_dist_plan", None) is not None:
-            raise NotImplementedError(
-                "no_sync/micro_step under a distributed plan needs a "
-                "collective-free program variant (planned); accumulate on the "
-                "single-program path or sync every step")
+        optimizer update; a following regular step folds them in.
+
+        Under a distributed plan (pure-DDP/replicate) the per-replica partial
+        gradients ride in a device-axis-sharded accumulator, so a K-step
+        window costs ONE all-reduce instead of K (reference no_sync +
+        _sync_grads, thunder/distributed/__init__.py:36,118)."""
+        plan = getattr(self.tmodule, "_dist_plan", None)
+        if plan is not None:
+            return self._micro_step_dist(plan, args, kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
         frozen_arrays = {k: p.data for k, p in frozen.items()}
@@ -161,6 +176,95 @@ class TrainStep:
             self._micro_jitted = jax.jit(micro, donate_argnums=(2,) if self.donate else ())
         loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
         return loss
+
+    # -- distributed no_sync (pure-DDP plans) --
+    _vag_nosync = None
+    _micro_dist_jitted = None
+    _fold_dist_jitted = None
+
+    @staticmethod
+    def _check_pure_ddp(plan):
+        for name, sts in plan.param_strategies.items():
+            if any(st.kind != "replicate" for st in sts):
+                raise NotImplementedError(
+                    "no_sync supports pure-DDP (replicate) plans; FSDP/TP "
+                    "gradients synchronize per micro-batch inherently "
+                    "(reduce-scatter is part of the sharded backward)")
+
+    def _dist_specs(self, plan, trainable, frozen, batch_args, batch_kwargs):
+        from jax.sharding import PartitionSpec as P
+
+        param_specs, frozen_specs, args_specs, kwargs_specs = _dist_in_specs(
+            plan, trainable, frozen, batch_args, batch_kwargs)
+        acc_specs = {k: P(plan.loss_axis_name, *([None] * v.ndim)) for k, v in trainable.items()}
+        return param_specs, frozen_specs, acc_specs, args_specs, kwargs_specs
+
+    def _micro_step_dist(self, plan, args, kwargs):
+        self._check_pure_ddp(plan)
+        trainable, frozen = self._split_params()
+        tparam_arrays = {k: p.data for k, p in trainable.items()}
+        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        if self._jitted is None:
+            if self.opt_state is None:
+                self.opt_state = self.optimizer.init(tparam_arrays)
+            self._build(args, kwargs)
+        if self._vag_nosync is None:
+            self._vag_nosync = self._make_vag(sync_loss=False)
+        if self._grad_acc is None:
+            self._grad_acc = {k: jnp.zeros((plan.loss_world_size,) + tuple(v.shape), v.dtype)
+                              for k, v in tparam_arrays.items()}
+        if self._micro_dist_jitted is None:
+            from jax.sharding import PartitionSpec as P
+
+            vagn = self._vag_nosync
+            ndev = plan.loss_world_size
+            axes = plan.loss_axis_name
+
+            def micro_raw(tparams, frozen_a, acc, a, kw):
+                loss_local, grads = vagn(tparams, frozen_a, a, kw)
+                g = grads[0][0]
+                new_acc = {k: acc[k] + g[k][None] for k in g}
+                loss = jax.lax.psum(loss_local, axes) / ndev
+                return loss, new_acc
+
+            pspec, fspec, aspec, args_specs, kwargs_specs = self._dist_specs(
+                plan, tparam_arrays, frozen_arrays, args, kwargs)
+            sm = _shard_map_compat(micro_raw, plan.mesh,
+                                   (pspec, fspec, aspec, args_specs, kwargs_specs),
+                                   (P(), aspec))
+            self._micro_dist_jitted = jax.jit(sm, donate_argnums=(2,) if self.donate else ())
+        loss, self._grad_acc = self._micro_dist_jitted(
+            tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
+        return loss
+
+    def _fold_dist(self, plan, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
+        """Final step of a distributed no_sync window: ONE all-reduce over
+        (fresh local grads + accumulated partials), then the optimizer."""
+        if self._fold_dist_jitted is None:
+            from jax.sharding import PartitionSpec as P
+
+            vagn = self._vag_nosync or self._make_vag(sync_loss=False)
+            self._vag_nosync = vagn
+            optimizer = self.optimizer
+            ndev = plan.loss_world_size
+            axes = plan.loss_axis_name
+
+            def fold_raw(tparams, frozen_a, opt_st, acc, a, kw):
+                loss_local, grads = vagn(tparams, frozen_a, a, kw)
+                g = grads[0][0]
+                total = {k: jax.lax.psum(g[k] + acc[k][0], axes) / ndev for k in g}
+                new_params, new_state = optimizer.update(tparams, total, opt_st)
+                loss = jax.lax.psum(loss_local, axes) / ndev
+                return loss, new_params, new_state
+
+            pspec, fspec, aspec, args_specs, kwargs_specs = self._dist_specs(
+                plan, tparam_arrays, frozen_arrays, args, kwargs)
+            opt_specs = _opt_state_specs(opt_state, pspec)
+            sm = _shard_map_compat(fold_raw, plan.mesh,
+                                   (pspec, fspec, opt_specs, aspec, args_specs, kwargs_specs),
+                                   (P(), pspec, opt_specs))
+            self._fold_dist_jitted = jax.jit(sm, donate_argnums=(0, 2, 3) if self.donate else ())
+        return self._fold_dist_jitted(tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs)
 
     def _jitted_with_acc(self, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
         if self._jitted_with_acc_fn is None:
@@ -213,6 +317,26 @@ def _opt_state_specs(opt_state, param_specs: dict):
     return rec(opt_state)
 
 
+def _shard_map_compat(fn, mesh, in_specs, out_specs):
+    """jax.shard_map across the check_vma/check_rep keyword rename."""
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax: check_rep
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+
+
+def _dist_in_specs(plan, trainable, frozen, batch_args, batch_kwargs):
+    """PartitionSpecs for (params, frozen, args, kwargs) — the single source
+    of sharding rules shared by the synced step and the no_sync variants."""
+    param_specs = {k: plan.param_spec(k, v.ndim) for k, v in trainable.items()}
+    frozen_specs = {k: plan.param_spec(k, v.ndim) for k, v in frozen.items()}
+    args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_args)
+    kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_kwargs)
+    return param_specs, frozen_specs, args_specs, kwargs_specs
+
+
 def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwargs, donate):
     """Wrap the step in shard_map over the plan's mesh: params/opt-state use
     per-param specs, batch leaves shard dim 0 over the data axes, loss comes
@@ -223,19 +347,12 @@ def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwa
     all_params = tmodule.get_parameters()
     trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
     frozen = {k: p.data for k, p in all_params.items() if k not in trainable}
-    param_specs = {k: plan.param_spec(k, v.ndim) for k, v in trainable.items()}
-    frozen_specs = {k: plan.param_spec(k, v.ndim) for k, v in frozen.items()}
     if opt_state is None:
         raise RuntimeError("opt_state must be initialized before building the distributed step")
+    param_specs, frozen_specs, args_specs, kwargs_specs = _dist_in_specs(
+        plan, trainable, frozen, batch_args, batch_kwargs)
     opt_specs = _opt_state_specs(opt_state, param_specs)
-    args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_args)
-    kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), batch_kwargs)
-    in_specs = (param_specs, frozen_specs, opt_specs, args_specs, kwargs_specs)
-    out_specs = (P(), param_specs, opt_specs)
-    try:
-        smapped = jax.shard_map(raw_step, mesh=plan.mesh, in_specs=in_specs,
-                                out_specs=out_specs, check_vma=False)
-    except TypeError:  # older jax: check_rep
-        smapped = jax.shard_map(raw_step, mesh=plan.mesh, in_specs=in_specs,
-                                out_specs=out_specs, check_rep=False)
+    smapped = _shard_map_compat(raw_step, plan.mesh,
+                                (param_specs, frozen_specs, opt_specs, args_specs, kwargs_specs),
+                                (P(), param_specs, opt_specs))
     return jax.jit(smapped, donate_argnums=donate)
