@@ -8,6 +8,7 @@ import pytest
 import thunder_tpu as tt
 from thunder_tpu import nn, optim
 from thunder_tpu.models.litgpt import Config, GPT, GPTForCausalLM
+from thunder_tpu.ops import ltorch
 from thunder_tpu.training import TrainStep
 
 
@@ -90,3 +91,41 @@ def test_param_update_without_retrace(rng):
     o2 = tm(x)
     np.testing.assert_allclose(np.asarray(o2), np.asarray(o1) * 2.0, atol=1e-5)
     assert tm._cs.cache_misses == 1  # no retrace
+
+
+class TestResNet:
+    def test_forward_shapes(self, rng):
+        from thunder_tpu.models.resnet import build
+
+        m = tt.jit(build("test"))
+        x = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_trains(self, rng):
+        from thunder_tpu.models.resnet import build
+        from thunder_tpu.training import TrainStep
+
+        class Head(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.body = build("test")
+
+            def forward(self, x, y):
+                return ltorch.cross_entropy(self.body(x), y)
+
+        step = TrainStep(tt.jit(Head()), optim.AdamW(lr=1e-3))
+        x = jnp.asarray(rng.randn(4, 3, 32, 32).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 10, (4,)))
+        l0 = float(step(x, y))
+        for _ in range(6):
+            step(x, y)
+        assert float(step(x, y)) < l0
+
+    def test_bottleneck_variant_compiles(self, rng):
+        from thunder_tpu.models.resnet import ResNet, ResNetConfig
+
+        cfg = ResNetConfig(block="bottleneck", layers=(1, 1), num_classes=4, width=8)
+        m = tt.jit(ResNet(cfg))
+        x = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
+        assert tuple(m(x).shape) == (1, 4)

@@ -139,6 +139,37 @@ def bench_train_step(rng):
     return _timeit(run, idx, tgt, iters=10)
 
 
+@register("resnet50_fwd")
+def bench_resnet50(rng):
+    from thunder_tpu.models.resnet import build
+
+    model = build("resnet50", dtype=jnp.bfloat16)
+    tm = tt.jit(model)
+    x = _tensor(rng, (8, 3, 224, 224))
+    return _timeit(tm, x, iters=5)
+
+
+@register("moe_block")
+def bench_moe_block(rng):
+    from thunder_tpu.models.moe import MoEConfig, MoEMLP
+
+    cfg = MoEConfig(n_embd=1024, n_expert=8, n_expert_per_token=2)
+    mlp = MoEMLP(cfg, dtype=jnp.bfloat16)
+    tm = tt.jit(mlp)
+    x = _tensor(rng, (8, 512, cfg.n_embd))
+    return _timeit(tm, x, iters=10)
+
+
+@register("vit_b16_fwd")
+def bench_vit(rng):
+    from thunder_tpu.models.vit import ViT, configs
+
+    model = ViT(configs["vit-b16"], dtype=jnp.bfloat16)
+    tm = tt.jit(model)
+    x = _tensor(rng, (8, 3, 224, 224))
+    return _timeit(tm, x, iters=5)
+
+
 def main(pattern: str = ""):
     rng = np.random.RandomState(0)
     for name, fn in BENCHMARKS.items():

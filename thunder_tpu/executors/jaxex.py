@@ -247,12 +247,18 @@ _reg(PrimIDs.CUMMAX, _cummax)
 
 
 def _reduce_window(a, window_dims, strides, padding, *, op="max"):
+    import numpy as np
+
+    dt = jnp.asarray(a).dtype
+    is_float = jnp.issubdtype(dt, jnp.floating)
     init, fn = {
-        "max": (-jnp.inf if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.iinfo(jnp.asarray(a).dtype).min, lax.max),
-        "min": (jnp.inf if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else jnp.iinfo(jnp.asarray(a).dtype).max, lax.min),
+        "max": (-np.inf if is_float else np.iinfo(dt).min, lax.max),
+        "min": (np.inf if is_float else np.iinfo(dt).max, lax.min),
         "sum": (0, lax.add),
     }[op]
-    init = jnp.asarray(init, jnp.asarray(a).dtype)
+    # concrete numpy scalar init: required for jax's monoid fast-path, which
+    # is what makes reduce_window reverse-mode differentiable
+    init = np.array(init, dt)[()]
     return lax.reduce_window(a, init, fn, tuple(int(w) for w in window_dims),
                              tuple(int(s) for s in strides), tuple((int(l), int(h)) for l, h in padding))
 

@@ -1,0 +1,132 @@
+"""ResNet (v1.5) in thunder_tpu's op language.
+
+Capability counterpart of the reference's ResNet50 benchmark target
+(thunder/benchmarks/targets.py torchvision entries). Exercises the conv /
+batch-norm / pooling prim family: convolutions lower to XLA conv (MXU),
+pooling to ReduceWindow (executors/jaxex.py REDUCE_WINDOW).
+
+BatchNorm here is the functional form: in training mode batch statistics are
+used in-graph and running stats are NOT updated in place (the framework is
+functional; a training loop that needs running stats carries them explicitly).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops import ltorch
+
+
+@dataclass
+class ResNetConfig:
+    block: str = "bottleneck"  # 'basic' | 'bottleneck'
+    layers: tuple = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    in_channels: int = 3
+
+
+configs = {
+    "resnet18": ResNetConfig(block="basic", layers=(2, 2, 2, 2)),
+    "resnet34": ResNetConfig(block="basic", layers=(3, 4, 6, 3)),
+    "resnet50": ResNetConfig(block="bottleneck", layers=(3, 4, 6, 3)),
+    "resnet101": ResNetConfig(block="bottleneck", layers=(3, 4, 23, 3)),
+    "test": ResNetConfig(block="basic", layers=(1, 1), num_classes=10, width=16),
+}
+
+
+class BatchNorm2d(nn.Module):
+    def __init__(self, channels: int, dtype=jnp.float32):
+        super().__init__()
+        self.weight = nn.Parameter(jnp.ones((channels,), dtype))
+        self.bias = nn.Parameter(jnp.zeros((channels,), dtype))
+
+    def forward(self, x):
+        return ltorch.batch_norm(x, None, None, self.weight, self.bias, training=True)
+
+
+class ConvBN(nn.Module):
+    def __init__(self, cin, cout, k, stride=1, padding=0, *, seed=None, dtype=jnp.float32):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, k, stride=stride, padding=padding, bias=False,
+                              seed=seed, dtype=dtype)
+        self.bn = BatchNorm2d(cout, dtype)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, cout, stride=1, *, seed=0, dtype=jnp.float32):
+        super().__init__()
+        self.cbr1 = ConvBN(cin, cout, 3, stride, 1, seed=seed, dtype=dtype)
+        self.cbr2 = ConvBN(cout, cout, 3, 1, 1, seed=seed + 1, dtype=dtype)
+        self.down = (ConvBN(cin, cout, 1, stride, 0, seed=seed + 2, dtype=dtype)
+                     if stride != 1 or cin != cout else None)
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        out = ltorch.relu(self.cbr1(x))
+        out = self.cbr2(out)
+        return ltorch.relu(ltorch.add(out, idn))
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, cout, stride=1, *, seed=0, dtype=jnp.float32):
+        super().__init__()
+        self.cbr1 = ConvBN(cin, cout, 1, 1, 0, seed=seed, dtype=dtype)
+        # v1.5: stride on the 3x3, not the 1x1
+        self.cbr2 = ConvBN(cout, cout, 3, stride, 1, seed=seed + 1, dtype=dtype)
+        self.cbr3 = ConvBN(cout, cout * 4, 1, 1, 0, seed=seed + 2, dtype=dtype)
+        cexp = cout * 4
+        self.down = (ConvBN(cin, cexp, 1, stride, 0, seed=seed + 3, dtype=dtype)
+                     if stride != 1 or cin != cexp else None)
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        out = ltorch.relu(self.cbr1(x))
+        out = ltorch.relu(self.cbr2(out))
+        out = self.cbr3(out)
+        return ltorch.relu(ltorch.add(out, idn))
+
+
+class ResNet(nn.Module):
+    def __init__(self, cfg: ResNetConfig, dtype=jnp.float32):
+        super().__init__()
+        self.cfg = cfg
+        block_cls = BasicBlock if cfg.block == "basic" else Bottleneck
+        w = cfg.width
+        self.stem = ConvBN(cfg.in_channels, w, 7, 2, 3, seed=1, dtype=dtype)
+
+        cin = w
+        seed = 10
+        self.stages = nn.ModuleList()
+        for i, n_blocks in enumerate(cfg.layers):
+            cout = w * (2 ** i)
+            stride = 1 if i == 0 else 2
+            blocks = []
+            for j in range(n_blocks):
+                blocks.append(block_cls(cin, cout, stride if j == 0 else 1, seed=seed, dtype=dtype))
+                cin = cout * block_cls.expansion
+                seed += 10
+            self.stages.append(nn.Sequential(*blocks))
+        self.fc = nn.Linear(cin, cfg.num_classes, seed=999, dtype=dtype)
+
+    def forward(self, x):
+        out = ltorch.relu(self.stem(x))
+        out = ltorch.max_pool2d(out, 3, 2, 1)
+        for st in self.stages:
+            out = st(out)
+        out = ltorch.adaptive_avg_pool2d(out, (1, 1))
+        out = ltorch.reshape(out, (out.shape[0], out.shape[1]))
+        return self.fc(out)
+
+
+def build(name: str = "resnet50", dtype=jnp.float32) -> ResNet:
+    return ResNet(configs[name], dtype=dtype)

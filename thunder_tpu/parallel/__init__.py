@@ -14,4 +14,5 @@ from .mesh import (
     single_device_mesh,
 )
 from . import prims
+from .gspmd import gspmd_step, shard_constraint
 from .transforms import DDPTransform, DistPlan, FSDPTransform, ParamStrategy, ddp, fsdp

@@ -1,0 +1,163 @@
+"""GSPMD parallelism: the compiler-partitioned road.
+
+The explicit road (parallel/transforms.py) inserts collective prims into the
+trace and runs under shard_map — inspectable, thunder-style. This module is
+the second road SURVEY §7 calls for: annotate shardings (params via
+NamedSharding on the jitted step's inputs, activations via the
+`shard_constraint` prim) and let XLA's SPMD partitioner insert the
+collectives. Cheaper to adopt, less explicit; both roads share DistPlan.
+
+Reference analog: the DTensor/experimental path
+(thunder/torch/experimental/dtensor_proxy.py) where sharded tensors flow
+through traces and the backend partitions.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.proxies import TensorProxy
+from ..core.symbol import OpTags, Symbol
+from ..executors.jaxex import ex as jax_ex
+
+# ---------------------------------------------------------------------------
+# shard_constraint prim: with_sharding_constraint as a first-class IR symbol
+# ---------------------------------------------------------------------------
+
+
+def _shard_constraint_meta(x, spec):
+    return TensorProxy(shape=x.shape, dtype=x.dtype, device=x.device)
+
+
+def _shard_constraint_impl(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError) as e:
+        if "mesh" in str(e).lower():
+            # no mesh context (single-device run of a mesh-annotated
+            # program): the constraint is advisory, the value is unchanged.
+            # Under gspmd_step the mesh context is installed around the
+            # jitted call, so the constraint binds there.
+            return x
+        raise
+
+
+shard_constraint = Symbol("shard_constraint", _shard_constraint_meta, id="gspmd.shard_constraint",
+                          is_prim=True, module="dist_prims", tags=(OpTags.DONT_FUSE,))
+jax_ex.register_implementation(shard_constraint.id, _shard_constraint_impl)
+
+
+def _register_grad():
+    from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+    @register_augmented_forward(shard_constraint.id)
+    def _sc_aug(x, spec):
+        return VJPResult(shard_constraint(x, spec), (spec,))
+
+    @register_backward(shard_constraint.id)
+    def _sc_bwd(spec, g):
+        # the cotangent keeps the same layout
+        return shard_constraint(g, spec), None
+
+
+_register_grad()
+
+
+# ---------------------------------------------------------------------------
+# GSPMD training step
+# ---------------------------------------------------------------------------
+
+
+def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
+    """A TrainStep-compatible step where XLA's SPMD partitioner handles the
+    collectives: parameters/optimizer state carry NamedShardings from the
+    plan, the batch shards over the data axes, and the loss is the global
+    mean — no explicit collective prims, no shard_map."""
+    from ..training import TrainStep, _batch_pspec
+
+    step = TrainStep(tmodule, optimizer, donate=donate)
+    if getattr(step.tmodule, "_dist_plan", None) is not None:
+        raise ValueError("gspmd_step and the explicit ddp()/fsdp() road are mutually "
+                         "exclusive: pass the plan here, don't install it on the module")
+    # place parameter storage on its target sharding up front: the optimizer
+    # state then inherits it (zeros_like), and the jitted step's in_shardings
+    # match the actual arg placements
+    for name, p in step.tmodule.get_parameters().items():
+        p.data = jax.device_put(p.data, NamedSharding(plan.mesh, plan.param_spec(name, p.data.ndim)))
+
+    class _GSPMDStep(TrainStep):
+        def _build(self, batch_args, batch_kwargs):
+            optimizer = self.optimizer
+            # plain inner: no collective prims — GSPMD partitions globally
+            vag = TrainStep._make_vag(self, sync_loss=True)
+            self._vag = vag
+
+            def raw_step(tparams, frozen, opt_state, args, kwargs):
+                loss, grads = vag(tparams, frozen, args, kwargs)
+                new_params, new_state = optimizer.update(tparams, grads[0][0], opt_state)
+                return loss, new_params, new_state
+
+            mesh = plan.mesh
+            all_params = self.tmodule.get_parameters()
+            trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
+            frozen = {k: p.data for k, p in all_params.items() if k not in trainable}
+            pshard = {k: NamedSharding(mesh, plan.param_spec(k, v.ndim)) for k, v in trainable.items()}
+            fshard = {k: NamedSharding(mesh, plan.param_spec(k, v.ndim)) for k, v in frozen.items()}
+            # optimizer state follows its parameter's sharding where shapes match
+            oshard = _opt_shardings(self.opt_state, pshard, mesh)
+            bshard_args = jax.tree_util.tree_map(
+                lambda l: NamedSharding(mesh, _batch_pspec(plan, l)), batch_args)
+            bshard_kwargs = jax.tree_util.tree_map(
+                lambda l: NamedSharding(mesh, _batch_pspec(plan, l)), batch_kwargs)
+            jitted = jax.jit(
+                raw_step,
+                in_shardings=(pshard, fshard, oshard, bshard_args, bshard_kwargs),
+                # pin outputs so updated params keep their declared layout
+                # (otherwise XLA may pick a different sharding and the next
+                # call's in_shardings mismatch)
+                out_shardings=(NamedSharding(mesh, P()), pshard, oshard),
+                donate_argnums=(0, 2) if self.donate else (),
+            )
+
+            ctx_mesh = _auto_mesh(mesh)
+            _mesh_ctx = getattr(jax.sharding, "use_mesh", None) or jax.sharding.set_mesh
+
+            def jitted_with_mesh(*a, **kw):
+                # mesh context makes bare-PartitionSpec shard_constraint
+                # annotations inside the traced program bind to this mesh
+                with _mesh_ctx(ctx_mesh):
+                    return jitted(*a, **kw)
+
+            self._jitted = jitted_with_mesh
+
+    step.__class__ = _GSPMDStep
+    return step
+
+
+def _auto_mesh(mesh):
+    """Mesh with Auto axis types: under jax's explicit-sharding mode,
+    with_sharding_constraint over an Explicit mesh asserts instead of
+    hinting; Auto keeps the classic GSPMD hint semantics."""
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is None:
+        return mesh
+    try:
+        return Mesh(mesh.devices, mesh.axis_names,
+                    axis_types=(axis_type.Auto,) * len(mesh.axis_names))
+    except TypeError:
+        return mesh
+
+
+def _opt_shardings(opt_state, param_shardings: dict, mesh):
+    """NamedShardings for the optimizer state, reusing the spec-derivation
+    heuristic from training._opt_state_specs (per-param state follows its
+    parameter; everything else replicates)."""
+    from ..training import _opt_state_specs
+
+    param_specs = {k: s.spec for k, s in param_shardings.items()}
+    specs = _opt_state_specs(opt_state, param_specs)
+    return jax.tree_util.tree_map(lambda spec: NamedSharding(mesh, spec), specs,
+                                  is_leaf=lambda x: isinstance(x, P))

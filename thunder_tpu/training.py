@@ -211,7 +211,16 @@ class TrainStep:
         if self._vag_nosync is None:
             self._vag_nosync = self._make_vag(sync_loss=False)
         if self._grad_acc is None:
-            self._grad_acc = {k: jnp.zeros((plan.loss_world_size,) + tuple(v.shape), v.dtype)
+            # allocate the accumulator already sharded over the device axis
+            # (a plain jnp.zeros would materialize world_size x params on one
+            # device before resharding — an OOM hazard at scale)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def _sharded_zeros(shape, dtype):
+                sh = NamedSharding(plan.mesh, P(plan.loss_axis_name, *([None] * (len(shape) - 1))))
+                return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)()
+
+            self._grad_acc = {k: _sharded_zeros((plan.loss_world_size,) + tuple(v.shape), v.dtype)
                               for k, v in tparam_arrays.items()}
         if self._micro_dist_jitted is None:
             from jax.sharding import PartitionSpec as P
