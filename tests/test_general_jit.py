@@ -223,3 +223,25 @@ class TestSameInputCache:
         np.testing.assert_allclose(np.asarray(cf(x, x)), 2 * x, atol=1e-6)
         np.testing.assert_allclose(np.asarray(cf(x, x)), 2 * x, atol=1e-6)
         assert cf.cache_misses == 1 and cf.cache_hits == 1
+
+
+class TestInterpreterLog:
+    def test_records_and_prints(self, rng, capsys):
+        def f(x):
+            return ltorch.mul(x, 2.0)
+
+        cf = tt.jit(f, interpretation="python interpreter", record_interpreter_log=True)
+        cf(rng.rand(2, 2).astype(np.float32))
+        log = tt.last_interpreter_log(cf)
+        assert any("LOAD_FAST" in ln for ln in log)
+        tt.print_last_interpreter_log(cf, limit=5)
+        out = capsys.readouterr().out
+        assert "RESUME" in out or "LOAD" in out
+
+    def test_off_by_default(self, rng):
+        def f(x):
+            return ltorch.mul(x, 2.0)
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        cf(rng.rand(2, 2).astype(np.float32))
+        assert tt.last_interpreter_log(cf) == []

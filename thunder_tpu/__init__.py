@@ -320,6 +320,26 @@ def last_prologue_traces(cfn) -> list:
     return _get_cs(cfn).last_prologue_traces
 
 
+def last_interpreter_log(cfn) -> list:
+    """Instruction log of the last acquisition (bytecode-interpreter frontend
+    with record_interpreter_log=True; reference thunder/__init__.py:1032)."""
+    log = getattr(_get_cs(cfn), "last_interpreter_log", None)
+    if log is None:
+        raise ValueError("no interpreter log recorded — compile with "
+                         "interpretation='python interpreter' and record_interpreter_log=True")
+    return log
+
+
+def print_last_interpreter_log(cfn, limit: int = 200) -> None:
+    """Render the last acquisition's interpreted-instruction trace
+    (reference print_last_interpreter_log, thunder/__init__.py:1032-1062)."""
+    log = last_interpreter_log(cfn)
+    shown = log[:limit]
+    print("\n".join(shown))
+    if len(log) > limit:
+        print(f"... ({len(log) - limit} more instructions)")
+
+
 def cache_hits(cfn) -> int:
     return _get_cs(cfn).cache_hits
 

@@ -286,9 +286,25 @@ def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, sc
     return o
 
 
+# jit-wrapped at registration: a claimed op dispatched standalone (outside a
+# fusion region) would otherwise re-lower the pallas_call on every invocation.
+# Each wrapper normalizes static args to hashables and falls back to the
+# unjitted impl if a static arg turns out to be a tracer.
+_sdpa_jitted = jax.jit(_sdpa_flash_impl, static_argnames=("dropout_p", "is_causal", "scale"))
+
+
+def _sdpa_claimed(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    try:
+        return _sdpa_jitted(q, k, v, attn_mask,
+                            float(dropout_p), bool(is_causal),
+                            None if scale is None else float(scale))
+    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        return _sdpa_flash_impl(q, k, v, attn_mask, dropout_p, is_causal, scale)
+
+
 ex.register_implementation(
     "torch.nn.functional.scaled_dot_product_attention",
-    _sdpa_flash_impl,
+    _sdpa_claimed,
     checker=flash_attention_supported,
 )
 
@@ -395,7 +411,22 @@ def _xent_impl(logits, target, weight=None, ignore_index=-100, reduction="mean",
     return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
 
 
-ex.register_implementation("torch.nn.functional.cross_entropy", _xent_impl, checker=_xent_supported)
+_xent_jitted = jax.jit(_xent_impl, static_argnames=("ignore_index", "reduction", "label_smoothing"))
+
+
+def _xent_claimed(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    try:
+        return _xent_jitted(logits, target, weight,
+                            int(ignore_index), str(reduction), float(label_smoothing))
+    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        return _xent_impl(logits, target, weight, ignore_index, reduction, label_smoothing)
+
+
+ex.register_implementation(
+    "torch.nn.functional.cross_entropy",
+    _xent_claimed,
+    checker=_xent_supported,
+)
 
 
 # ===========================================================================
@@ -440,4 +471,19 @@ def _rms_impl(a, normalized_shape, weight=None, eps=1e-6):
     return out.reshape(shape)
 
 
-ex.register_implementation("torch.nn.functional.rms_norm", _rms_impl, checker=_rms_supported)
+_rms_jitted = jax.jit(_rms_impl, static_argnames=("normalized_shape", "eps"))
+
+
+def _rms_claimed(a, normalized_shape, weight=None, eps=1e-6):
+    shape_t = tuple(int(d) for d in normalized_shape)
+    try:
+        return _rms_jitted(a, shape_t, weight, float(eps))
+    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        return _rms_impl(a, shape_t, weight, eps)
+
+
+ex.register_implementation(
+    "torch.nn.functional.rms_norm",
+    _rms_claimed,
+    checker=_rms_supported,
+)

@@ -46,6 +46,12 @@ class InterpretedFunction:
         self.lookasides = lookasides
         self.cache_option = cache
         self.disable_fusion = disable_fusion
+        dbg = compile_options.pop("debug_options", None)
+        self.record_interpreter_log = bool(
+            compile_options.pop("record_interpreter_log", False)
+            or (dbg is not None and (getattr(dbg, "show_interpreter_log", False)
+                                     or getattr(dbg, "record_interpreter_history", False))))
+        self._print_interpreter_log = bool(dbg is not None and getattr(dbg, "show_interpreter_log", False))
         self._entries: list[InterpretedEntry] = []
         self._cs = CompileStats()
         self.__name__ = getattr(fn, "__name__", type(fn).__name__)
@@ -78,7 +84,11 @@ class InterpretedFunction:
         res, treedef, mask, leaves = general_jit(self.fn, args, kwargs,
                                                  sharp_edges=self.sharp_edges,
                                                  lookasides=self.lookasides,
-                                                 symbolic_numbers=self.cache_option == "symbolic values")
+                                                 symbolic_numbers=self.cache_option == "symbolic values",
+                                                 record_log=self.record_interpreter_log)
+        cs.last_interpreter_log = list(res.log)
+        if self._print_interpreter_log and res.log:
+            print("\n".join(res.log))
         cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
         t1 = time.perf_counter_ns()

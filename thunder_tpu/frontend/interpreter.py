@@ -240,13 +240,16 @@ class Interpreter:
     def __init__(self, *, lookasides: dict | None = None,
                  on_provenance_load: Callable[[Any, Provenance], Any] | None = None,
                  on_sharp_edge: Callable[[str], None] | None = None,
-                 max_depth: int = 64):
+                 max_depth: int = 64, record_log: bool = False):
         self.lookasides = {**default_lookasides(), **(lookasides or {})}
         self.on_provenance_load = on_provenance_load
         self.on_sharp_edge = on_sharp_edge or (lambda msg: None)
         self.max_depth = max_depth
         self.depth = 0
+        # instruction logging (reference interpreter.py:457 — every interpreted
+        # instruction recorded; rendered by print_last_interpreter_log)
         self.log: list[str] = []
+        self.record_log = record_log
 
     # -- value wrapping with jit callback --
     def _loaded(self, value: Any, prov: Provenance) -> WrappedValue:
@@ -350,6 +353,10 @@ class Interpreter:
     def step(self, frame: Frame, fn, ins: dis.Instruction) -> Optional[int]:
         """Execute one instruction. Returns a jump target offset or None."""
         op = ins.opname
+        if self.record_log:
+            lineno = ins.positions.lineno if ins.positions else None
+            self.log.append(f"{'  ' * self.depth}{fn.__qualname__}:{lineno} "
+                            f"{op} {ins.argrepr or ins.argval if ins.arg is not None else ''}")
         handler = getattr(self, f"op_{op}", None)
         if handler is None:
             raise InterpreterError(

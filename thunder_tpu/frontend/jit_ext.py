@@ -60,6 +60,7 @@ class JitResults(NamedTuple):
     computation_trc: TraceCtx
     captured: list
     sharp_edges: list
+    log: tuple = ()
 
 
 class GeneralJitCtx:
@@ -133,7 +134,8 @@ class GeneralJitCtx:
 
 def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
                 lookasides: dict | None = None,
-                symbolic_numbers: bool = False) -> tuple[JitResults, Any, list, list]:
+                symbolic_numbers: bool = False,
+                record_log: bool = False) -> tuple[JitResults, Any, list, list]:
     """Interpret fn over proxies, producing prologue + computation traces.
 
     Returns (JitResults, treedef, tensor_mask, leaves) — same surface as
@@ -176,7 +178,8 @@ def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
 
         interp = Interpreter(lookasides=lookasides,
                              on_provenance_load=ctx.on_provenance_load,
-                             on_sharp_edge=ctx.on_sharp_edge)
+                             on_sharp_edge=ctx.on_sharp_edge,
+                             record_log=record_log)
         observe_ctx = (number_observation(lambda p: pinned.add(p.name))
                        if symbolic_numbers else contextlib.nullcontext())
         with observe_ctx:
@@ -189,7 +192,7 @@ def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
     trc.args = arg_proxies + tuple(number_proxies) + tuple(c.proxy for c in ctx.captured)
 
     pro = _build_prologue(fn, arg_proxies, ctx, number_proxies=number_proxies, pinned=pinned)
-    res = JitResults(pro, trc, ctx.captured, ctx.sharp_edges)
+    res = JitResults(pro, trc, ctx.captured, ctx.sharp_edges, interp.log)
     return res, treedef, tensor_mask, leaves
 
 
