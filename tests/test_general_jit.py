@@ -3,6 +3,8 @@ captures, prologue generation, constant-values cache semantics (counterpart
 of reference thunder/tests/test_jit_general.py)."""
 import math
 
+import jax
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -245,3 +247,83 @@ class TestInterpreterLog:
         cf = tt.jit(f, interpretation="python interpreter")
         cf(rng.rand(2, 2).astype(np.float32))
         assert tt.last_interpreter_log(cf) == []
+
+
+class TestInplaceAssignment:
+    """Functionalized `x[k] = v` under the interpreter frontend (reference
+    update_aliases, thunder/core/update_aliases.py:143)."""
+
+    def test_slice_assignment(self, rng):
+        def f(cache, new_vals):
+            cache[2:4] = new_vals
+            return ltorch.sum(ltorch.mul(cache, cache))
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        c = rng.randn(6, 3).astype(np.float32)
+        nv = rng.randn(2, 3).astype(np.float32)
+        ref = c.copy()
+        ref[2:4] = nv
+        np.testing.assert_allclose(float(cf(c, nv)), (ref * ref).sum(), atol=1e-4)
+
+    def test_int_index_assignment_visible_after(self, rng):
+        def g(x):
+            x[0] = ltorch.mul(x[1], 2.0)
+            return ltorch.sum(x)
+
+        cg = tt.jit(g, interpretation="python interpreter")
+        x = rng.randn(3, 4).astype(np.float32)
+        rx = x.copy()
+        rx[0] = rx[1] * 2
+        np.testing.assert_allclose(float(cg(x)), rx.sum(), atol=1e-4)
+
+    def test_setitem_prim_grads(self, rng):
+        from thunder_tpu.core import prims
+
+        def f(c, nv):
+            c2 = prims.copy_with_setitem(c, slice(2, 4), nv)
+            return ltorch.sum(ltorch.mul(c2, c2))
+
+        c = rng.randn(6, 3).astype(np.float32)
+        nv = rng.randn(2, 3).astype(np.float32)
+        _, ((gc, gnv), _) = tt.value_and_grad(f, argnums=(0, 1))(c, nv)
+        want_gc, want_gnv = jax.grad(
+            lambda c, nv: jnp.sum(c.at[2:4].set(nv) ** 2), argnums=(0, 1))(c, nv)
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(want_gc), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gnv), np.asarray(want_gnv), atol=1e-4)
+
+    def test_direct_tracer_raises_with_guidance(self, rng):
+        def g(x):
+            x[0] = ltorch.mul(x[1], 2.0)
+            return ltorch.sum(x)
+
+        with pytest.raises(TypeError, match="python interpreter"):
+            tt.jit(g)(rng.randn(3, 4).astype(np.float32))
+
+    def test_cross_frame_alias_sees_update(self, rng):
+        def helper(t, v):
+            t[0] = v
+            return t
+
+        def f(cache, nv):
+            out = helper(cache, nv)
+            return ltorch.add(ltorch.sum(cache), ltorch.sum(out))
+
+        cf = tt.jit(f, interpretation="python interpreter")
+        c = rng.randn(2, 2).astype(np.float32)
+        nv = rng.randn(2).astype(np.float32)
+        ref = c.copy()
+        ref[0] = nv
+        np.testing.assert_allclose(float(cf(c, nv)), 2 * ref.sum(), atol=1e-4)
+
+    def test_container_alias_sees_update(self, rng):
+        def g(x, v):
+            ys = [x]
+            x[0] = v
+            return ltorch.sum(ys[0])
+
+        cg = tt.jit(g, interpretation="python interpreter")
+        c = rng.randn(2, 2).astype(np.float32)
+        nv = rng.randn(2).astype(np.float32)
+        ref = c.copy()
+        ref[0] = nv
+        np.testing.assert_allclose(float(cg(c, nv)), ref.sum(), atol=1e-4)

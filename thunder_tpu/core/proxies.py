@@ -460,6 +460,15 @@ class TensorProxy(Proxy):
     def __getitem__(self, key):
         return get_method("getitem")(self, key)
 
+    def __setitem__(self, key, value):
+        raise TypeError(
+            "in-place indexed assignment on a traced tensor is only supported "
+            "under the bytecode-interpreter frontend "
+            "(jit(..., interpretation='python interpreter'), which rewrites "
+            "`x[k] = v` to a functional copy_with_setitem); in directly-traced "
+            "code use `x = ltorch.scatter(...)` / `clang.getitem`-style "
+            "functional updates instead")
+
     def __hash__(self):
         return hash(self.name)
 

@@ -213,8 +213,13 @@ class Frame:
         self.exc_table = _parse_exception_table(code)
         self.block_depths: list[int] = []  # exception handler stack depths
         self.exc_stack: list[BaseException] = []  # live handlers' exceptions
+        # applied to every pushed value: routes stale tensor aliases to their
+        # functionalized replacements (in-place assignment support)
+        self.resolver = None
 
     def push(self, v):
+        if self.resolver is not None:
+            v = self.resolver(v)
         self.stack.append(v)
 
     def pop(self):
@@ -250,6 +255,31 @@ class Interpreter:
         # instruction recorded; rendered by print_last_interpreter_log)
         self.log: list[str] = []
         self.record_log = record_log
+        # proxy redirects: name of a functionally-updated tensor -> its
+        # replacement. Consulted on every value push, so stale aliases in any
+        # frame, container, or capture cache observe the update (the
+        # acquisition-time form of reference update_aliases,
+        # thunder/core/update_aliases.py:143)
+        self.redirects: dict[str, Any] = {}
+
+    def _resolve_pushed(self, v):
+        if not self.redirects:
+            return v
+        from ..core.proxies import TensorProxy
+
+        raw = unwrap(v)
+        if not isinstance(raw, TensorProxy):
+            return v
+        cur = self.redirects.get(raw.name)
+        if cur is None:
+            return v
+        while True:
+            nxt = self.redirects.get(cur.name)
+            if nxt is None:
+                break
+            cur = nxt
+        # the updated value is computed, not a pure load — 'op' provenance
+        return wrap(cur, Provenance("op"))
 
     # -- value wrapping with jit callback --
     def _loaded(self, value: Any, prov: Provenance) -> WrappedValue:
@@ -310,6 +340,7 @@ class Interpreter:
             for name, cell in zip(code.co_freevars, fn.__closure__):
                 cells[name] = cell
         frame = Frame(code, fn.__globals__, vars(builtins), localsplus, cells)
+        frame.resolver = self._resolve_pushed
         self.depth += 1
         try:
             return self.run_frame(frame, fn)
@@ -575,8 +606,46 @@ class Interpreter:
 
     def op_STORE_SUBSCR(self, frame, fn, ins):
         key, obj, val = unwrap(frame.pop()), unwrap(frame.pop()), unwrap(frame.pop())
+        from ..core.proxies import TensorProxy
+
+        if isinstance(obj, TensorProxy):
+            self._functionalize_setitem(frame, obj, key, val)
+            return None
         obj[key] = val
         return None
+
+    def _functionalize_setitem(self, frame, obj, key, val):
+        """Rewrite `x[key] = v` to a functional copy_with_setitem (the
+        acquisition-time form of reference update_aliases). The old proxy is
+        redirected to the new one, so any alias — another frame's local, a
+        container element, a re-loaded global — resolves to the updated
+        tensor on its next load. Aliases already held inside opaque native
+        state are the one remaining blind spot."""
+        from ..core import prims as _prims
+
+        new = _prims.copy_with_setitem(obj, key, val)
+        self.redirects[obj.name] = new
+        self._rebind_proxy(frame, obj, new)
+
+    @staticmethod
+    def _rebind_proxy(frame, old, new) -> bool:
+        hit = False
+        for name, w in list(frame.locals.items()):
+            if unwrap(w) is old:
+                frame.locals[name] = wrap(new, Provenance("op"))
+                hit = True
+        for name, cell in frame.cells.items():
+            try:
+                if unwrap(cell.cell_contents) is old:
+                    cell.cell_contents = new
+                    hit = True
+            except ValueError:
+                continue
+        for i, w in enumerate(frame.stack):
+            if unwrap(w) is old:
+                frame.stack[i] = wrap(new, Provenance("op"))
+                hit = True
+        return hit
 
     def op_DELETE_SUBSCR(self, frame, fn, ins):
         key, obj = unwrap(frame.pop()), unwrap(frame.pop())
@@ -591,6 +660,11 @@ class Interpreter:
     def op_STORE_SLICE(self, frame, fn, ins):
         end, start, obj, val = (unwrap(frame.pop()), unwrap(frame.pop()),
                                 unwrap(frame.pop()), unwrap(frame.pop()))
+        from ..core.proxies import TensorProxy
+
+        if isinstance(obj, TensorProxy):
+            self._functionalize_setitem(frame, obj, slice(start, end), val)
+            return None
         obj[start:end] = val
         return None
 

@@ -66,7 +66,7 @@ def has_grad_rule(sym_id) -> bool:
 JAX_VJP_FALLBACK: set = {
     PrimIDs.CONVOLUTION, PrimIDs.GROUPED_MM, PrimIDs.ATAN2, PrimIDs.CUMSUM,
     PrimIDs.CUMPROD, PrimIDs.REDUCE_WINDOW, PrimIDs.CONV_TRANSPOSE, PrimIDs.EINSUM,
-    PrimIDs.DIGAMMA, PrimIDs.SCATTER,
+    PrimIDs.DIGAMMA, PrimIDs.SCATTER, PrimIDs.COPY_WITH_SETITEM,
 }
 
 
