@@ -572,5 +572,66 @@ def _ref_pixel_shuffle(a, r):
     return out.reshape(N, C // (r * r), H * r, W * r)
 
 
-all_opinfos = unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos + nn_opinfos + widened_opinfos
+wave2_opinfos = [
+    OpInfo(name="unfold_im2col", op=lambda a: ltorch.unfold(a, 3, 1, 1, 2),
+           ref=lambda a: _ref_unfold(a, 3, 1, 1, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 8, 8), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="fold_col2im", op=lambda a: ltorch.fold(a, (6, 6), 3),
+           ref=lambda a: _ref_fold(a, (6, 6), 3),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 27, 16), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="tensor_unfold", op=lambda a: ltorch.tensor_unfold(a, 1, 4, 2),
+           ref=lambda a: jnp.stack([a[:, i:i+4] for i in range(0, a.shape[1]-3, 2)], 1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 10), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="embedding_bag_mean", op=lambda i, w: ltorch.embedding_bag(i, w, mode="mean"),
+           ref=lambda i, w: jnp.take(w, i, axis=0).mean(axis=1),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray(rng.randint(0, 20, (3, 5))), make_tensor(rng, (20, 6), dt)))]),
+           dtypes=F32_64),
+    OpInfo(name="lp_pool2d", op=lambda a: ltorch.lp_pool2d(a, 2, 2),
+           ref=lambda a: jax.lax.reduce_window(a ** 2, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID") ** 0.5,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 8, 8), dt, low=0.1, high=2.0),))]),
+           dtypes=F32, atol=1e-3, rtol=1e-3),
+    OpInfo(name="channel_shuffle", op=lambda a: ltorch.channel_shuffle(a, 3),
+           ref=lambda a: a.reshape(a.shape[0], 3, a.shape[1] // 3, *a.shape[2:]).swapaxes(1, 2).reshape(a.shape),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 6, 4, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="triplet_margin_loss", op=ltorch.triplet_margin_loss,
+           ref=lambda a, p, n: jnp.mean(jnp.maximum(
+               jnp.linalg.norm(a - p, axis=-1) - jnp.linalg.norm(a - n, axis=-1) + 1.0, 0.0)),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5, 8), dt), make_tensor(rng, (5, 8), dt), make_tensor(rng, (5, 8), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+]
+
+
+def _ref_unfold(a, ks, dil, pad, st):
+    a = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    N, C, H, W = a.shape
+    oh = (H - (ks - 1) * dil - 1) // st + 1
+    ow = (W - (ks - 1) * dil - 1) // st + 1
+    cols = []
+    for i in range(ks):
+        for j in range(ks):
+            cols.append(a[:, :, i*dil:i*dil+(oh-1)*st+1:st, j*dil:j*dil+(ow-1)*st+1:st].reshape(N, C, -1))
+    return jnp.concatenate([c[:, :, None, :] for c in cols], 2).reshape(N, C*ks*ks, -1)
+
+
+def _ref_fold(a, out_size, ks):
+    H, W = out_size
+    N = a.shape[0]
+    C = a.shape[1] // (ks * ks)
+    oh, ow = H - ks + 1, W - ks + 1
+    cols = a.reshape(N, C, ks*ks, oh, ow)
+    out = jnp.zeros((N, C, H, W), a.dtype)
+    for i in range(ks):
+        for j in range(ks):
+            out = out.at[:, :, i:i+oh, j:j+ow].add(cols[:, :, i*ks+j])
+    return out
+
+
+all_opinfos = (unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos
+               + nn_opinfos + widened_opinfos + wave2_opinfos)
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]

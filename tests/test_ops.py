@@ -6,7 +6,102 @@ import pytest
 from framework import EXECUTOR_MODES, ops, run_op_test
 from opinfos import all_opinfos
 
+import thunder_tpu as tt
+from thunder_tpu.ops import ltorch
+
 
 @ops(all_opinfos)
 def test_op_vs_reference(opinfo, mode, dtype, rng):
     run_op_test(opinfo, mode, dtype, rng)
+
+
+# --- wave-2 ops with rng keys / composite semantics (direct tests) ---
+
+
+class TestWave2Direct:
+    def test_multi_head_attention(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        B, T, E, H = 2, 6, 16, 4
+        q = jnp.asarray(rng.randn(B, T, E).astype(np.float32))
+        win = jnp.asarray(rng.randn(3 * E, E).astype(np.float32) * 0.1)
+        bin_ = jnp.asarray(rng.randn(3 * E).astype(np.float32) * 0.1)
+        wout = jnp.asarray(rng.randn(E, E).astype(np.float32) * 0.1)
+        bout = jnp.asarray(rng.randn(E).astype(np.float32) * 0.1)
+        out = np.asarray(tt.jit(
+            lambda q_, a, b, c, d: ltorch.multi_head_attention_forward(q_, q_, q_, H, a, b, c, d)
+        )(q, win, bin_, wout, bout))
+        # reference in plain jax
+        qq = np.asarray(q) @ np.asarray(win)[:E].T + np.asarray(bin_)[:E]
+        kk = np.asarray(q) @ np.asarray(win)[E:2*E].T + np.asarray(bin_)[E:2*E]
+        vv = np.asarray(q) @ np.asarray(win)[2*E:].T + np.asarray(bin_)[2*E:]
+        def heads(t):
+            return t.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+        s = heads(qq) @ heads(kk).transpose(0, 1, 3, 2) / np.sqrt(E // H)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        o = (p @ heads(vv)).transpose(0, 2, 1, 3).reshape(B, T, E)
+        want = o @ np.asarray(wout).T + np.asarray(bout)
+        np.testing.assert_allclose(out, want, atol=1e-3)
+
+    def test_gumbel_softmax_hard_one_hot(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        logits = jnp.asarray(rng.randn(5, 8).astype(np.float32))
+        key = jax.random.PRNGKey(0)
+        out = np.asarray(tt.jit(lambda l, k: ltorch.gumbel_softmax(l, 0.7, True, -1, key=k))(logits, key))
+        np.testing.assert_allclose(out.sum(-1), np.ones(5), atol=1e-5)
+        assert ((out == out.max(-1, keepdims=True)) | (out < 1e-6)).all()
+
+    def test_dropout2d_channelwise(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.ones((4, 8, 5, 5), np.float32))
+        key = jax.random.PRNGKey(1)
+        out = np.asarray(tt.jit(lambda a, k: ltorch.dropout2d(a, 0.5, True, key=k))(x, key))
+        # each channel is either fully zero or fully scaled
+        per_channel = out.reshape(4, 8, -1)
+        assert all(np.all(c == c[0]) for img in per_channel for c in img)
+
+    def test_alpha_dropout_preserves_stats(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(rng.randn(200, 200).astype(np.float32))
+        key = jax.random.PRNGKey(2)
+        out = np.asarray(tt.jit(lambda a, k: ltorch.alpha_dropout(a, 0.3, True, key=k))(x, key))
+        assert abs(out.mean()) < 0.05 and abs(out.std() - 1.0) < 0.1
+
+    def test_cosine_embedding_and_multilabel_losses(self, rng):
+        import torch
+        import torch.nn.functional as F
+
+        a = rng.randn(5, 8).astype(np.float32)
+        b = rng.randn(5, 8).astype(np.float32)
+        tgt = np.sign(rng.randn(5)).astype(np.float32)
+        got = float(tt.jit(lambda x, y, t: ltorch.cosine_embedding_loss(x, y, t))(a, b, tgt))
+        want = float(F.cosine_embedding_loss(torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(tgt)))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+        lbl = (rng.rand(5, 8) > 0.5).astype(np.float32)
+        got = float(tt.jit(lambda x, t: ltorch.multilabel_soft_margin_loss(x, t))(a, lbl))
+        want = float(F.multilabel_soft_margin_loss(torch.from_numpy(a), torch.from_numpy(lbl)))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_lp_pool_odd_p_matches_torch_nan(self, rng):
+        import torch
+        import torch.nn.functional as F
+
+        x = rng.randn(1, 1, 4, 4).astype(np.float32)
+        got = np.asarray(tt.jit(lambda a: ltorch.lp_pool2d(a, 3, 2))(x))
+        want = F.lp_pool2d(torch.from_numpy(x), 3, 2).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4, equal_nan=True)
+
+    def test_embedding_bag_rejects_offsets_with_2d(self, rng):
+        idx = np.zeros((2, 3), np.int32)
+        w = np.ones((4, 5), np.float32)
+        with pytest.raises(Exception, match="offsets"):
+            tt.jit(lambda i, ww: ltorch.embedding_bag(i, ww, offsets=np.zeros(2, np.int32)))(idx, w)

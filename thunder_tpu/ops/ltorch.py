@@ -2232,3 +2232,238 @@ def hinge_embedding_loss(input, target, margin=1.0, reduction="mean"):
 def margin_ranking_loss(input1, input2, target, margin=0.0, reduction="mean"):
     loss = clang.maximum(clang.add(clang.mul(prims.neg(target), clang.sub(input1, input2)), margin), 0.0)
     return _apply_reduction(loss, reduction)
+
+
+# im2col family --------------------------------------------------------------
+
+
+def _pair(v):
+    """int-or-(a, b) normalization shared by the im2col family."""
+    if isinstance(v, (int, NumberProxy)):
+        n = int(pyval(v))
+        return n, n
+    a, b = v
+    return int(pyval(a)), int(pyval(b))
+
+
+@torchsymbol(name="unfold", id="torch.nn.functional.unfold")
+def unfold(a, kernel_size, dilation=1, padding=0, stride=1):
+    """F.unfold (im2col): (N, C, H, W) -> (N, C*kh*kw, L). Decomposed into
+    kh*kw strided slices (static unroll; XLA fuses into one gather)."""
+    kh, kw = _pair(kernel_size)
+    dh, dw = _pair(dilation)
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    N, C, H, W = a.shape
+    if ph or pw:
+        a = clang.pad(a, 0.0, [(0, 0, 0), (0, 0, 0), (ph, ph, 0), (pw, pw, 0)])
+        H, W = H + 2 * ph, W + 2 * pw
+    oh = (H - (kh - 1) * dh - 1) // sh + 1
+    ow = (W - (kw - 1) * dw - 1) // sw + 1
+    patches = []
+    for i in builtins.range(kh):
+        for j in builtins.range(kw):
+            r0, c0 = i * dh, j * dw
+            sl = prims.slice_prim(a, (0, 0, r0, c0),
+                                  (N, C, r0 + (oh - 1) * sh + 1, c0 + (ow - 1) * sw + 1),
+                                  (1, 1, sh, sw))
+            patches.append(clang.reshape(sl, (N, C, 1, oh * ow)))
+    out = clang.cat(patches, 2)  # (N, C, kh*kw, L)
+    return clang.reshape(out, (N, C * kh * kw, oh * ow))
+
+
+@torchsymbol(name="fold", id="torch.nn.functional.fold")
+def fold(a, output_size, kernel_size, dilation=1, padding=0, stride=1):
+    """F.fold (col2im): (N, C*kh*kw, L) -> (N, C, H, W), overlaps summed."""
+    H, W = _pair(output_size)
+    kh, kw = _pair(kernel_size)
+    dh, dw = _pair(dilation)
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    N = a.shape[0]
+    C = a.shape[1] // (kh * kw)
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+    oh = (Hp - (kh - 1) * dh - 1) // sh + 1
+    ow = (Wp - (kw - 1) * dw - 1) // sw + 1
+    cols = clang.reshape(a, (N, C, kh * kw, oh, ow))
+    out = clang.full((N, C, Hp, Wp), 0.0, dtype=a.dtype, device=a.device)
+    # scatter each kernel position back with stride-interior padding
+    for i in builtins.range(kh):
+        for j in builtins.range(kw):
+            idx = i * kw + j
+            piece = clang.squeeze(clang.slice_in_dim(cols, idx, idx + 1, 2), (2,))  # (N,C,oh,ow)
+            r0, c0 = i * dh, j * dw
+            expanded = clang.pad(piece, 0.0, [
+                (0, 0, 0), (0, 0, 0),
+                (r0, Hp - r0 - ((oh - 1) * sh + 1), sh - 1),
+                (c0, Wp - c0 - ((ow - 1) * sw + 1), sw - 1),
+            ])
+            out = clang.add(out, expanded)
+    if ph or pw:
+        out = prims.slice_prim(out, (0, 0, ph, pw), (N, C, ph + H, pw + W), (1, 1, 1, 1))
+    return out
+
+
+@torchsymbol(name="tensor_unfold", method_names=("unfold",))
+def tensor_unfold(a, dim, size, step):
+    """Tensor.unfold: sliding windows of `size` every `step` along dim."""
+    dim = canonicalize_dim(a.ndim, pyval(dim))
+    size, step = pyval(size), pyval(step)
+    n = (a.shape[dim] - size) // step + 1
+    slices = []
+    for w in builtins.range(n):
+        sl = clang.slice_in_dim(a, w * step, w * step + size, dim)
+        slices.append(clang.unsqueeze(sl, dim))
+    out = clang.cat(slices, dim)  # windows at dim, window content at dim+1
+    # torch puts the window content LAST
+    return clang.movedim(out, dim + 1, out.ndim - 1) if dim + 1 != out.ndim - 1 else out
+
+
+# attention / embedding ------------------------------------------------------
+
+
+@torchsymbol(name="embedding_bag", id="torch.nn.functional.embedding_bag")
+def embedding_bag(indices, weight, offsets=None, mode="mean"):
+    """2D-input form: (B, L) indices -> (B, D) pooled embeddings."""
+    check(indices.ndim == 2, lambda: "embedding_bag supports the 2D (B, L) input form")
+    check(offsets is None, lambda: "offsets is only valid with 1D indices (torch semantics); "
+                                   "the 2D form bags along dim 1")
+    emb = prims.embedding(indices, weight)  # (B, L, D)
+    if mode == "sum":
+        return clang.sum_(emb, 1, False)
+    if mode == "max":
+        return clang.amax(emb, 1, False)
+    return clang.mean(emb, 1, False)
+
+
+@torchsymbol(name="multi_head_attention_forward", id="thunder_tpu.multi_head_attention")
+def multi_head_attention_forward(query, key, value, num_heads, in_proj_weight, in_proj_bias=None,
+                                 out_proj_weight=None, out_proj_bias=None, is_causal=False):
+    """Packed-projection MHA, batch-first (B, T, E) -> (B, T, E).
+
+    Deliberately NOT registered under the torch.nn.functional id: torch's
+    function is seq-first, takes embed_dim_to_check before num_heads, and
+    returns (output, weights) — binding this simplified form there would
+    silently misinterpret arguments."""
+    B, Tq, E = query.shape
+    H = pyval(num_heads)
+    hd = E // H
+    wq = clang.slice_in_dim(in_proj_weight, 0, E, 0)
+    wk = clang.slice_in_dim(in_proj_weight, E, 2 * E, 0)
+    wv = clang.slice_in_dim(in_proj_weight, 2 * E, 3 * E, 0)
+    q = prims.linear(query, wq, None)
+    k = prims.linear(key, wk, None)
+    v = prims.linear(value, wv, None)
+    if in_proj_bias is not None:
+        q = clang.add(q, clang.slice_in_dim(in_proj_bias, 0, E, 0))
+        k = clang.add(k, clang.slice_in_dim(in_proj_bias, E, 2 * E, 0))
+        v = clang.add(v, clang.slice_in_dim(in_proj_bias, 2 * E, 3 * E, 0))
+
+    def split_heads(t):
+        Bt, Tt, _ = t.shape
+        return clang.transpose(clang.reshape(t, (Bt, Tt, H, hd)), 1, 2)
+
+    o = sdpa(split_heads(q), split_heads(k), split_heads(v), is_causal=is_causal)
+    o = clang.reshape(clang.transpose(o, 1, 2), (B, Tq, E))
+    if out_proj_weight is not None:
+        o = prims.linear(o, out_proj_weight, None)
+        if out_proj_bias is not None:
+            o = clang.add(o, out_proj_bias)
+    return o
+
+
+@torchsymbol(name="gumbel_softmax", id="torch.nn.functional.gumbel_softmax")
+def gumbel_softmax(logits, tau=1.0, hard=False, dim=-1, *, key=None):
+    check(key is not None, lambda: "gumbel_softmax requires an rng key (key=)")
+    u = prims.uniform(logits.shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=logits.device)
+    eps = 1e-10
+    g = prims.neg(prims.log(clang.add(prims.neg(prims.log(clang.add(u, eps))), eps)))
+    y = softmax.meta(clang.true_divide(clang.add(logits, g), tau), dim)
+    if hard:
+        idx = clang.argmax(y, dim, True)
+        # straight-through: hard one-hot forward, soft gradient
+        oh = scatter(clang.full_like(y, 0.0), dim, idx, 1.0)
+        return clang.add(clang.sub(oh, prims.stop_gradient(y)), y)
+    return y
+
+
+# pooling / shuffle ----------------------------------------------------------
+
+
+@torchsymbol(name="lp_pool2d", id="torch.nn.functional.lp_pool2d")
+def lp_pool2d(a, norm_type, kernel_size, stride=None):
+    p = float(pyval(norm_type))
+    ks, st, _ = _pool_args(kernel_size, stride, 0, 2)
+    # torch semantics: sum(x^p)^(1/p) with NO abs — odd p on negative sums
+    # yields NaN exactly like torch does
+    powed = clang.pow_(a, p)
+    s = prims.reduce_window(powed, (1, 1) + ks, (1, 1) + st, ((0, 0),) * 4, op="sum")
+    return clang.pow_(s, 1.0 / p)
+
+
+@torchsymbol(name="channel_shuffle", id="torch.nn.functional.channel_shuffle")
+def channel_shuffle(a, groups):
+    g = pyval(groups)
+    N, C = a.shape[0], a.shape[1]
+    rest = a.shape[2:]
+    out = clang.reshape(a, (N, g, C // g) + rest)
+    out = clang.transpose(out, 1, 2)
+    return clang.reshape(out, (N, C) + rest)
+
+
+@torchsymbol(name="dropout2d", id="torch.nn.functional.dropout2d")
+def dropout2d(a, p=0.5, training=True, *, key=None):
+    """Channel-wise dropout for (N, C, H, W)."""
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "dropout2d in training mode requires an rng key (key=)")
+    keep = 1.0 - p
+    mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+    mask = clang.lt(prims.uniform(mask_shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    mask = clang.expand_to(clang.maybe_convert_to_dtype(mask, a.dtype), a.shape)
+    return clang.mul(clang.mul(a, mask), 1.0 / keep)
+
+
+@torchsymbol(name="alpha_dropout", id="torch.nn.functional.alpha_dropout")
+def alpha_dropout(a, p=0.5, training=True, *, key=None):
+    """SELU-preserving dropout (torch semantics: keeps self-normalizing stats)."""
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "alpha_dropout in training mode requires an rng key (key=)")
+    alpha_prime = -1.7580993408473766
+    keep = 1.0 - p
+    mask = clang.lt(prims.uniform(a.shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    A = (keep + alpha_prime * alpha_prime * keep * (1 - keep)) ** -0.5
+    Bc = -A * alpha_prime * (1 - keep)
+    dropped = clang.where(mask, a, clang.full_like(a, alpha_prime))
+    return clang.add(clang.mul(dropped, A), Bc)
+
+
+# losses (second wave) -------------------------------------------------------
+
+
+@torchsymbol(name="triplet_margin_loss", id="torch.nn.functional.triplet_margin_loss")
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0, reduction="mean"):
+    dp = norm.meta(clang.sub(anchor, positive), pyval(p), -1, False)
+    dn = norm.meta(clang.sub(anchor, negative), pyval(p), -1, False)
+    loss = clang.maximum(clang.add(clang.sub(dp, dn), margin), 0.0)
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="cosine_embedding_loss", id="torch.nn.functional.cosine_embedding_loss")
+def cosine_embedding_loss(x1, x2, target, margin=0.0, reduction="mean"):
+    cos = cosine_similarity.meta(x1, x2, -1)
+    pos = clang.sub(1.0, cos)
+    neg = clang.maximum(clang.sub(cos, margin), 0.0)
+    loss = clang.where(clang.gt(target, 0), pos, neg)
+    return _apply_reduction(loss, reduction)
+
+
+@torchsymbol(name="multilabel_soft_margin_loss", id="torch.nn.functional.multilabel_soft_margin_loss")
+def multilabel_soft_margin_loss(input, target, reduction="mean"):
+    neg_abs = prims.neg(prims.abs(input))
+    log_sig = prims.neg(clang.add(clang.maximum(prims.neg(input), 0.0), prims.log1p(prims.exp(neg_abs))))
+    log_sig_neg = clang.sub(log_sig, input)
+    loss = prims.neg(clang.add(clang.mul(target, log_sig), clang.mul(clang.sub(1.0, target), log_sig_neg)))
+    loss = clang.mean(loss, -1, False)
+    return _apply_reduction(loss, reduction)
