@@ -35,3 +35,35 @@ def test_metrics_populated(rng):
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8)))
     _, m = engine.generate(prompt, max_new_tokens=4)
     assert m.ttft_s > 0 and m.tbot_s > 0 and m.tokens_per_sec > 0
+
+
+def test_scan_decode_matches_loop(rng):
+    """One-dispatch scan decode (the CUDA-graphs analog) produces the exact
+    token sequence of the per-step loop."""
+    from thunder_tpu.inference import GPTInference
+    from thunder_tpu.models.litgpt import Config, GPT
+
+    cfg = Config.from_name("tiny-llama2")
+    gpt = GPT(cfg, dtype=jnp.float32)
+    inf = GPTInference(gpt, dtype=jnp.float32)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    out_scan, m_scan = inf.generate(prompt, 8, scan_decode=True)
+    out_loop, m_loop = inf.generate(prompt, 8, scan_decode=False)
+    np.testing.assert_array_equal(np.asarray(out_scan), np.asarray(out_loop))
+    assert out_scan.shape == (2, 20)
+
+
+def test_scan_decode_batch_change_then_loop(rng):
+    """Changing batch size between scan generations must not poison the
+    decode cache with scan tracers (regression)."""
+    from thunder_tpu.inference import GPTInference
+    from thunder_tpu.models.litgpt import Config, GPT
+
+    cfg = Config.from_name("tiny-llama2")
+    inf = GPTInference(GPT(cfg, dtype=jnp.float32), dtype=jnp.float32)
+    p2 = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    p4 = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 12)), jnp.int32)
+    inf.generate(p2, 6, scan_decode=True)
+    inf.generate(p4, 6, scan_decode=True)
+    out, _ = inf.generate(p4, 6, scan_decode=False)
+    assert out.shape == (4, 18)

@@ -165,14 +165,46 @@ class GPTInference:
         self._prefill_cfn = _jit(prefill)
         self._decode_cfn = _jit(decode)
 
+    def _build_scan_decode(self, n_steps: int):
+        """Compile the WHOLE greedy decode loop into one XLA program via
+        lax.scan over the compiled decode step (the role CUDA graphs play in
+        the reference: per-token dispatch overhead drops to zero — one
+        dispatch generates all n_steps tokens). The compiled decode entry is
+        traceable because its generated prologue/computation are pure jax."""
+        decode = self._decode_cfn
+
+        def scan_decode(params, first_tok, ks, vs, start_pos):
+            def step(carry, _):
+                tok, ks, vs, pos = carry
+                logits, ks, vs = decode(params, tok[:, None], ks, vs, pos)
+                nxt = jnp.argmax(logits, -1).astype(tok.dtype)
+                return (nxt, ks, vs, pos + 1), nxt
+
+            (last, ks, vs, _), toks = jax.lax.scan(
+                step, (first_tok, ks, vs, jnp.asarray(start_pos, jnp.int32)),
+                None, length=n_steps)
+            return toks, ks, vs  # toks: (n_steps, B)
+
+        self._scan_jitted = jax.jit(scan_decode, static_argnames=())
+        self._scan_steps = n_steps
+        return self._scan_jitted
+
+    _scan_jitted = None
+    _scan_steps = None
+    _scan_sig = None
+
     def generate(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
-                 collect_metrics: bool = False):
-        """prompt: (B, T) int array. Returns (tokens (B, T+max_new), metrics)."""
+                 collect_metrics: bool = False, scan_decode: bool = True):
+        """prompt: (B, T) int array. Returns (tokens (B, T+max_new), metrics).
+
+        scan_decode=True (greedy only): all decode steps compile into one XLA
+        program — one dispatch for the whole generation."""
         cfg = self.cfg
         B, T = prompt.shape
         if self._decode_cfn is None:
             self._build(B, T)
-        params = {k: p for k, p in self.gpt.named_parameters()}
+        # raw arrays: Parameter wrappers don't abstract under the jitted scan
+        params = {k: p.data for k, p in self.gpt.named_parameters()}
         cache = KVCache(cfg.n_layer, B, cfg.n_query_groups, self.max_seq, cfg.head_size, self.dtype)
         ks, vs = cache.as_tuple()
 
@@ -182,26 +214,52 @@ class GPTInference:
         jax.block_until_ready(next_tok)
         ttft = time.perf_counter() - t_start
 
-        toks = [next_tok]
-        pos = T
+        n_steps = max_new_tokens - 1
+        use_scan = scan_decode and temperature == 0.0 and n_steps > 0
         t_decode = time.perf_counter()
-        for _ in range(max_new_tokens - 1):
-            logits, ks, vs = self._decode_cfn(params, next_tok[:, None], ks, vs,
-                                              jnp.asarray(pos, jnp.int32))
-            if temperature > 0.0:
-                key = jax.random.PRNGKey(pos)
-                next_tok = jax.random.categorical(key, logits / temperature, -1).astype(prompt.dtype)
-            else:
-                next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
-            toks.append(next_tok)
-            pos += 1
-        jax.block_until_ready(next_tok)
-        dt = time.perf_counter() - t_decode
+        if use_scan:
+            sig = (n_steps, B, str(next_tok.dtype))
+            if self._scan_jitted is None or self._scan_sig != sig:
+                # warm-compile the decode entry with CONCRETE inputs first —
+                # compiling it inside the scan trace would bake tracers into
+                # the cached entry (outputs discarded; caches stay untouched).
+                # Keyed on the full (steps, batch, dtype) signature: a new
+                # batch size means a new decode cache entry to warm.
+                self._decode_cfn(params, next_tok[:, None], ks, vs, jnp.asarray(T, jnp.int32))
+                self._build_scan_decode(n_steps)
+                self._scan_sig = sig
+            toks_scan, ks, vs = self._scan_jitted(params, next_tok, ks, vs, T)
+            jax.block_until_ready(toks_scan)
+            dt = time.perf_counter() - t_decode
+            out = jnp.concatenate([prompt, next_tok[:, None], toks_scan.T.astype(prompt.dtype)], axis=1)
+            metrics = GenerationMetrics(
+                ttft_s=ttft,
+                tbot_s=dt / max(1, n_steps),
+                tokens_per_sec=B * max_new_tokens / (ttft + dt),
+                ms_per_token=1e3 * (ttft + dt) / max_new_tokens,
+                n_new_tokens=max_new_tokens,
+            )
+            return out, metrics
+        else:
+            toks = [next_tok]
+            pos = T
+            for _ in range(n_steps):
+                logits, ks, vs = self._decode_cfn(params, next_tok[:, None], ks, vs,
+                                                  jnp.asarray(pos, jnp.int32))
+                if temperature > 0.0:
+                    key = jax.random.PRNGKey(pos)
+                    next_tok = jax.random.categorical(key, logits / temperature, -1).astype(prompt.dtype)
+                else:
+                    next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+                toks.append(next_tok)
+                pos += 1
+            jax.block_until_ready(next_tok)
+            dt = time.perf_counter() - t_decode
 
         out = jnp.concatenate([prompt] + [t[:, None] for t in toks], axis=1)
         metrics = GenerationMetrics(
             ttft_s=ttft,
-            tbot_s=dt / max(1, max_new_tokens - 1),
+            tbot_s=dt / max(1, n_steps),
             tokens_per_sec=B * max_new_tokens / (ttft + dt),
             ms_per_token=1e3 * (ttft + dt) / max_new_tokens,
             n_new_tokens=max_new_tokens,

@@ -2328,6 +2328,7 @@ def embedding_bag(indices, weight, offsets=None, mode="mean"):
     check(indices.ndim == 2, lambda: "embedding_bag supports the 2D (B, L) input form")
     check(offsets is None, lambda: "offsets is only valid with 1D indices (torch semantics); "
                                    "the 2D form bags along dim 1")
+    check(mode in ("sum", "max", "mean"), lambda: f"embedding_bag: unknown mode {mode!r}")
     emb = prims.embedding(indices, weight)  # (B, L, D)
     if mode == "sum":
         return clang.sum_(emb, 1, False)
