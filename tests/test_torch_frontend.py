@@ -148,3 +148,47 @@ def test_hf_recipe_compile():
     with torch.no_grad():
         ref = model(input_ids=ids).logits.numpy()
     np.testing.assert_allclose(np.asarray(logits), ref, atol=1e-4)
+
+
+def test_torch_cnn_with_pooling_and_norms(rng):
+    """CNN using the wave-1/2 interop surface (conv+bn+hardswish+pools)."""
+    import torch
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(3, 8, 3, padding=1)
+            self.bn = tnn.BatchNorm2d(8)
+            self.fc = tnn.Linear(8, 10)
+
+        def forward(self, x):
+            h = F.hardswish(self.bn(self.conv(x)))
+            h = F.max_pool2d(h, 2)
+            h = F.adaptive_avg_pool2d(h, (1, 1)).flatten(1)
+            return F.log_softmax(self.fc(h), dim=-1)
+
+    net = Net().eval()
+    x = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        want = net(x).numpy()
+    got = np.asarray(tt.jit(net)(x))
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_torch_losses_and_unary_surface(rng):
+    import torch
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    class M(tnn.Module):
+        def forward(self, x, y):
+            return F.huber_loss(torch.log1p(torch.exp2(x).clamp_min(0.1)), y) + torch.logaddexp(x, y).sum()
+
+    a = torch.randn(4, 6)
+    b = torch.randn(4, 6)
+    m = M()
+    want = float(m(a, b))
+    got = float(tt.jit(m)(a, b))
+    np.testing.assert_allclose(got, want, atol=1e-3)

@@ -207,12 +207,12 @@ def _gelu(x, approximate="none"):
 
 
 @_register(F.softmax, torch.softmax, torch.Tensor.softmax)
-def _softmax(x, dim=None, *, dtype=None):
+def _softmax(x, dim=None, _stacklevel=3, *, dtype=None):
     return ltorch.softmax(x, -1 if dim is None else dim, dtype=dtype)
 
 
 @_register(F.log_softmax)
-def _log_softmax(x, dim=None, *, dtype=None):
+def _log_softmax(x, dim=None, _stacklevel=3, *, dtype=None):
     return ltorch.log_softmax(x, -1 if dim is None else dim, dtype=dtype)
 
 
@@ -505,6 +505,19 @@ _GENERIC_NAMES = {
     "gather", "index_select", "roll", "flip", "detach", "sort", "argsort",
     "logical_and", "logical_or", "logical_not", "bitwise_and", "bitwise_or",
     "isnan", "isfinite", "t",
+    # wave-1/2 surface (same name + signature in ltorch)
+    "square", "log2", "log10", "log1p", "expm1", "exp2", "sign", "trunc",
+    "round", "frac", "reciprocal", "asin", "acos", "atan", "sinh", "cosh",
+    "asinh", "acosh", "atanh", "erfc", "erfinv", "lgamma", "digamma",
+    "logaddexp", "logaddexp2", "hypot", "copysign", "fmod", "remainder",
+    "atan2", "logsumexp", "cumprod", "cummax", "count_nonzero", "nansum",
+    "nanmean", "nan_to_num", "norm", "narrow", "select", "unbind", "tile",
+    "repeat_interleave", "diag", "ravel", "unflatten", "broadcast_to",
+    "expand_as", "median", "aminmax", "movedim", "take_along_dim",
+    "scatter", "scatter_add", "index_add", "clamp_min", "clamp_max",
+    "bitwise_xor", "bitwise_not", "logical_xor", "xlogy", "heaviside",
+    "prod", "isinf", "signbit", "kron",
+    "tensordot", "dot", "mv", "vdot", "outer", "rsub",
 }
 
 _DUNDER_MAP = {
@@ -518,6 +531,173 @@ _DUNDER_MAP = {
     "__or__": ltorch.bitwise_or, "__invert__": ltorch.bitwise_not,
     "__mod__": ltorch.remainder,
 }
+
+
+@_register(F.conv1d)
+def _conv1d(x, w, b=None, stride=1, padding=0, dilation=1, groups=1):
+    return ltorch.conv1d(x, w, b, stride, padding, dilation, groups)
+
+
+@_register(F.conv3d)
+def _conv3d(x, w, b=None, stride=1, padding=0, dilation=1, groups=1):
+    return ltorch.conv3d(x, w, b, stride, padding, dilation, groups)
+
+
+@_register(F.conv_transpose2d)
+def _conv_t2d(x, w, b=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1):
+    return ltorch.conv_transpose2d(x, w, b, stride, padding, output_padding, groups, dilation)
+
+
+@_register(F.max_pool2d, torch.max_pool2d)
+def _max_pool2d(x, kernel_size, stride=None, padding=0, dilation=1, ceil_mode=False,
+                return_indices=False):
+    if dilation not in (1, (1, 1)) or ceil_mode or return_indices:
+        raise NotImplementedError("max_pool2d: dilation/ceil_mode/indices unsupported")
+    return ltorch.max_pool2d(x, kernel_size, stride, padding)
+
+
+@_register(F.avg_pool2d)
+def _avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                count_include_pad=True, divisor_override=None):
+    if ceil_mode or divisor_override is not None:
+        raise NotImplementedError("avg_pool2d: ceil_mode/divisor_override unsupported")
+    return ltorch.avg_pool2d(x, kernel_size, stride, padding, count_include_pad)
+
+
+@_register(F.adaptive_avg_pool2d)
+def _adaptive_avg_pool2d(x, output_size):
+    return ltorch.adaptive_avg_pool2d(x, output_size)
+
+
+@_register(F.batch_norm)
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+                momentum=0.1, eps=1e-5):
+    return ltorch.batch_norm(x, running_mean, running_var, weight, bias, training, momentum, eps)
+
+
+@_register(F.group_norm)
+def _group_norm(x, num_groups, weight=None, bias=None, eps=1e-5):
+    return ltorch.group_norm(x, num_groups, weight, bias, eps)
+
+
+@_register(F.instance_norm)
+def _instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                   use_input_stats=True, momentum=0.1, eps=1e-5):
+    if not use_input_stats:
+        raise NotImplementedError(
+            "instance_norm with running stats (track_running_stats eval mode) "
+            "is unsupported — ltorch.instance_norm always uses input statistics")
+    return ltorch.instance_norm(x, running_mean, running_var, weight, bias,
+                                use_input_stats, momentum, eps)
+
+
+@_register(F.normalize)
+def _normalize(x, p=2.0, dim=1, eps=1e-12, out=None):
+    return ltorch.normalize(x, p, dim, eps)
+
+
+@_register(F.unfold)
+def _unfold_f(x, kernel_size, dilation=1, padding=0, stride=1):
+    return ltorch.unfold(x, kernel_size, dilation, padding, stride)
+
+
+@_register(F.fold)
+def _fold_f(x, output_size, kernel_size, dilation=1, padding=0, stride=1):
+    return ltorch.fold(x, output_size, kernel_size, dilation, padding, stride)
+
+
+@_register(F.pixel_shuffle)
+def _pixel_shuffle(x, upscale_factor):
+    return ltorch.pixel_shuffle(x, upscale_factor)
+
+
+@_register(F.interpolate)
+def _interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=None,
+                 recompute_scale_factor=None, antialias=False):
+    if align_corners:
+        raise NotImplementedError("interpolate: align_corners=True unsupported")
+    if antialias:
+        raise NotImplementedError("interpolate: antialias=True unsupported")
+    return ltorch.interpolate(x, size, scale_factor, mode)
+
+
+@_register(F.elu)
+def _elu(x, alpha=1.0, inplace=False):
+    return ltorch.elu(x, alpha)
+
+
+@_register(F.leaky_relu)
+def _leaky_relu(x, negative_slope=0.01, inplace=False):
+    return ltorch.leaky_relu(x, negative_slope)
+
+
+@_register(F.hardswish)
+def _hardswish(x, inplace=False):
+    return ltorch.hardswish(x)
+
+
+@_register(F.hardsigmoid)
+def _hardsigmoid(x, inplace=False):
+    return ltorch.hardsigmoid(x)
+
+
+@_register(F.hardtanh)
+def _hardtanh(x, min_val=-1.0, max_val=1.0, inplace=False):
+    return ltorch.hardtanh(x, min_val, max_val)
+
+
+@_register(F.softplus)
+def _softplus(x, beta=1.0, threshold=20.0):
+    return ltorch.softplus(x, beta, threshold)
+
+
+@_register(F.mish)
+def _mish(x, inplace=False):
+    return ltorch.mish(x)
+
+
+@_register(F.l1_loss)
+def _l1_loss(input, target, size_average=None, reduce=None, reduction="mean"):
+    return ltorch.l1_loss(input, target, reduction)
+
+
+@_register(F.smooth_l1_loss)
+def _smooth_l1(input, target, size_average=None, reduce=None, reduction="mean", beta=1.0):
+    return ltorch.smooth_l1_loss(input, target, reduction, beta)
+
+
+@_register(F.huber_loss)
+def _huber(input, target, reduction="mean", delta=1.0, weight=None):
+    if weight is not None:
+        raise NotImplementedError("huber_loss: weight is unsupported")
+    return ltorch.huber_loss(input, target, reduction, delta)
+
+
+@_register(F.binary_cross_entropy)
+def _bce(input, target, weight=None, size_average=None, reduce=None, reduction="mean"):
+    return ltorch.binary_cross_entropy(input, target, weight, reduction)
+
+
+@_register(F.binary_cross_entropy_with_logits)
+def _bce_logits(input, target, weight=None, size_average=None, reduce=None,
+                reduction="mean", pos_weight=None):
+    return ltorch.binary_cross_entropy_with_logits(input, target, weight, pos_weight, reduction)
+
+
+@_register(F.kl_div)
+def _kl_div(input, target, size_average=None, reduce=None, reduction="mean", log_target=False):
+    return ltorch.kl_div(input, target, reduction, log_target)
+
+
+@_register(F.nll_loss)
+def _nll(input, target, weight=None, size_average=None, ignore_index=-100,
+         reduce=None, reduction="mean"):
+    return ltorch.nll_loss(input, target, weight, ignore_index, reduction)
+
+
+@_register(F.cosine_similarity, torch.cosine_similarity)
+def _cos_sim(x1, x2, dim=1, eps=1e-8):
+    return ltorch.cosine_similarity(x1, x2, dim, eps)
 
 
 def dispatch(func, args, kwargs):

@@ -835,15 +835,25 @@ def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mea
 
 
 @torchsymbol(name="nll_loss", id="torch.nn.functional.nll_loss")
-def nll_loss(log_probs, target, reduction="mean"):
+def nll_loss(log_probs, target, weight=None, ignore_index=-100, reduction="mean"):
     tgt = clang.unsqueeze(target, 1)
     picked = clang.squeeze(clang.take_along_axis(log_probs, tgt, 1), 1)
     nll = prims.neg(picked)
+    valid = clang.ne(target, ignore_index)
+    if weight is not None:
+        # per-sample class weights; torch normalizes the mean by their sum
+        safe_tgt = clang.where(valid, target, clang.full_like(target, 0))
+        w = clang.take(weight, safe_tgt, 0)
+        nll = clang.mul(nll, w)
+        denom = clang.sum_(clang.where(valid, w, clang.full_like(w, 0)))
+    else:
+        denom = clang.sum_(clang.maybe_convert_to_dtype(valid, nll.dtype))
+    nll = clang.where(valid, nll, clang.full_like(nll, 0))
     if reduction == "none":
         return nll
     if reduction == "sum":
         return clang.sum_(nll)
-    return clang.mean(nll)
+    return clang.true_divide(clang.sum_(nll), denom)
 
 
 @torchsymbol(name="mse_loss", id="torch.nn.functional.mse_loss")
