@@ -635,3 +635,59 @@ def _ref_fold(a, out_size, ks):
 all_opinfos = (unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos
                + nn_opinfos + widened_opinfos + wave2_opinfos)
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]
+
+
+# ---------------------------------------------------------------------------
+# error inputs (reference opinfos.py error-input generators, SURVEY §4.1)
+# ---------------------------------------------------------------------------
+
+
+def _err_matmul(rng):
+    yield (make_tensor(rng, (3, 4), dtypes.float32), make_tensor(rng, (5, 6), dtypes.float32)), {}, Exception, "matmul"
+
+
+def _err_reshape(rng):
+    yield (make_tensor(rng, (3, 4), dtypes.float32), (5, 5)), {}, Exception, "reshape|mismatch"
+
+
+def _err_cat(rng):
+    yield ([make_tensor(rng, (2, 3), dtypes.float32), make_tensor(rng, (2, 3, 4), dtypes.float32)], 0), {}, Exception, "rank|cat"
+
+
+def _err_squeeze(rng):
+    # squeezing a non-1 dim is a silent no-op per torch; wrong dim index raises
+    yield (make_tensor(rng, (2, 3), dtypes.float32), 5), {}, Exception, "dim|range|rank"
+
+
+def _err_embedding_bag(rng):
+    yield (jnp.zeros((2, 3), jnp.int32), make_tensor(rng, (5, 4), dtypes.float32)), {"mode": "meam"}, Exception, "mode"
+
+
+def _err_linear(rng):
+    yield (make_tensor(rng, (2, 8), dtypes.float32), make_tensor(rng, (4, 9), dtypes.float32)), {}, Exception, "linear"
+
+
+def _err_conv2d(rng):
+    # channel mismatch: must be caught at trace time by _convolution_meta
+    yield (make_tensor(rng, (1, 3, 8, 8), dtypes.float32), make_tensor(rng, (4, 5, 3, 3), dtypes.float32)), {}, Exception, "channels"
+
+
+def _err_einsum(rng):
+    yield ("ij,jk->ik", make_tensor(rng, (3, 4), dtypes.float32)), {}, Exception, "operand"
+
+
+def _err_cross_entropy(rng):
+    yield (make_tensor(rng, (2, 3, 4), dtypes.float32), jnp.zeros((2,), jnp.int32)), {}, Exception, "logits"
+
+
+ERROR_OPINFOS = [
+    ("matmul", ltorch.matmul, _err_matmul),
+    ("reshape", ltorch.reshape, _err_reshape),
+    ("cat", ltorch.cat, _err_cat),
+    ("squeeze", ltorch.squeeze, _err_squeeze),
+    ("embedding_bag", ltorch.embedding_bag, _err_embedding_bag),
+    ("linear", ltorch.linear, _err_linear),
+    ("conv2d", ltorch.conv2d, _err_conv2d),
+    ("einsum", ltorch.einsum, _err_einsum),
+    ("cross_entropy", ltorch.cross_entropy, _err_cross_entropy),
+]

@@ -3,8 +3,10 @@
 import numpy as np
 import pytest
 
+import re
+
 from framework import EXECUTOR_MODES, ops, run_op_test
-from opinfos import all_opinfos
+from opinfos import ERROR_OPINFOS, all_opinfos
 
 import thunder_tpu as tt
 from thunder_tpu.ops import ltorch
@@ -105,3 +107,17 @@ class TestWave2Direct:
         w = np.ones((4, 5), np.float32)
         with pytest.raises(Exception, match="offsets"):
             tt.jit(lambda i, ww: ltorch.embedding_bag(i, ww, offsets=np.zeros(2, np.int32)))(idx, w)
+
+
+# --- error inputs: invalid calls must raise at TRACE time with a message ---
+
+
+@pytest.mark.parametrize("name,op,gen", ERROR_OPINFOS, ids=[e[0] for e in ERROR_OPINFOS])
+def test_error_inputs(name, op, gen):
+    rng = np.random.RandomState(7)
+    for args, kwargs, exc_type, match in gen(rng):
+        with pytest.raises(exc_type) as ei:
+            tt.jit(lambda *a, **k: op(*a, **k))(*args, **kwargs)
+        if match:
+            assert re.search(match, str(ei.value), re.I), (
+                f"{name}: error message {str(ei.value)!r} lacks {match!r}")

@@ -783,6 +783,9 @@ linear = make_prim(PrimIDs.LINEAR, "linear", _linear_meta, tags=(OpTags.MATMUL_O
 
 def _convolution_meta(a, weight, bias, stride, padding, dilation, groups):
     # a: (N, Cin, *spatial), weight: (Cout, Cin/groups, *kernel) — torch layout
+    check(a.shape[1] == weight.shape[1] * groups,
+          lambda: f"convolution: input channels {a.shape[1]} != weight in-channels "
+                  f"{weight.shape[1]} * groups {groups}")
     n_spatial = a.ndim - 2
     stride = tuple(pyval(s) for s in stride)
     padding = tuple(pyval(p) for p in padding)
