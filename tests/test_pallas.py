@@ -62,6 +62,15 @@ def test_checker_accepts_gpt2_shapes():
     # unaligned sequence length stays on the composite path
     q_bad = FakeProxy((8, 12, 100, 64))
     assert not pallasex.flash_attention_supported(q_bad, q_bad, q_bad, None, 0.0, True, None)
+    # GQA/MQA (fewer k/v heads) must fall back: the kernel grid indexes k/v
+    # blocks by q's head id
+    kv = FakeProxy((8, 4, 1024, 64))
+    assert not pallasex.flash_attention_supported(q, kv, kv, None, 0.0, True, None)
+    # mismatched head dim / kv seq len also fall back
+    v_bad = FakeProxy((8, 12, 1024, 128))
+    assert not pallasex.flash_attention_supported(q, q, v_bad, None, 0.0, True, None)
+    k_short = FakeProxy((8, 12, 512, 64))
+    assert not pallasex.flash_attention_supported(q, k_short, k_short, None, 0.0, False, None)
 
 
 def test_sdpa_symbol_claims_flash_end_to_end(rng):
@@ -91,3 +100,35 @@ def test_fused_rms_norm_matches(rng):
     out = pallasex.fused_rms_norm(x, w)
     ref = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+
+def test_sdpa_gqa_falls_back_to_composite(rng):
+    """GQA shapes must not be claimed by the flash kernel (its grid indexes
+    k/v blocks by q's head id); the composite path replicates kv heads."""
+    B, Hq, Hkv, T, D = 2, 8, 2, 256, 64
+    q = jnp.asarray(rng.randn(B, Hq, T, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Hkv, T, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Hkv, T, D).astype(np.float32))
+
+    calls = {"n": 0}
+    orig = pallasex.flash_attention_forward
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    pallasex.flash_attention_forward = spy
+    try:
+        fn = tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True, enable_gqa=True))
+        out = np.asarray(fn(q, k, v))
+    finally:
+        pallasex.flash_attention_forward = orig
+    assert calls["n"] == 0
+
+    kk = jnp.repeat(k, Hq // Hkv, axis=1)
+    vv = jnp.repeat(v, Hq // Hkv, axis=1)
+    np.testing.assert_allclose(out, np.asarray(_ref_attn(q, kk, vv)), atol=2e-3)
+
+    # without enable_gqa, mismatched heads is an error (torch semantics)
+    with pytest.raises(RuntimeError, match="enable_gqa"):
+        tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v))(q, k, v)

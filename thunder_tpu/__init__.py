@@ -239,6 +239,13 @@ def jit(
     """
     from .nn.module import Module, ThunderModule
 
+    _is_torch_module = type(fn).__module__.partition(".")[0] == "torch" or any(
+        c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]
+    )
+    if cache in ("symbolic values", "same input") and (isinstance(fn, Module) or _is_torch_module):
+        raise ValueError(
+            f"cache={cache!r} is only supported for plain callables "
+            f"(modules always take tensor inputs; use 'constant values')")
     if interpretation is not None:
         if interpretation not in ("python interpreter", "interpreter"):
             raise ValueError(f"unknown interpretation mode {interpretation!r}")
@@ -251,14 +258,7 @@ def jit(
         raise ValueError(
             "sharp_edges checking requires the bytecode-interpreter frontend: "
             "pass interpretation='python interpreter'")
-    _is_torch_module = type(fn).__module__.partition(".")[0] == "torch" or any(
-        c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]
-    )
     if cache in ("symbolic values", "same input"):
-        if isinstance(fn, Module) or _is_torch_module:
-            raise ValueError(
-                f"cache={cache!r} is only supported for plain callables "
-                f"(modules always take tensor inputs; use 'constant values')")
         # these cache modes live on the prologue machinery of the
         # interpreter frontend (reference thunder/core/options.py:45-49)
         from .frontend.compiled import InterpretedFunction

@@ -110,14 +110,22 @@ def del_last_used(trace: TraceCtx) -> TraceCtx:
     seen: set = set()
     out: list[BoundSymbol] = []
     arg_names = {p.name for p in trace.args}
+    protected: set = set()
+    for bsym in trace.bound_symbols:
+        # UNPACK_TRIVIAL prints no code, so its proxies have no local binding
+        if bsym.sym.id == PrimIDs.UNPACK_TRIVIAL:
+            for p in list(bsym.flat_proxy_args()) + list(bsym.flat_proxy_outs()):
+                protected.add(p.name)
     for bsym in reversed(trace.bound_symbols):
-        if bsym.sym.id in (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT):
+        if bsym.sym.id in (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL):
+            for p in bsym.flat_proxy_args():
+                seen.add(variableify(p))
             out.append(bsym)
             continue
         to_del = []
         for p in bsym.flat_proxy_args():
             v = variableify(p)
-            if v not in seen and p.name not in arg_names:
+            if v not in seen and p.name not in arg_names and p.name not in protected:
                 seen.add(v)
                 to_del.append(p)
         for p in bsym.flat_proxy_outs():

@@ -264,16 +264,23 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     return dq, dk, dv
 
 
-def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None) -> bool:
+def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False) -> bool:
     """Checker: pallas flash attention claims sdpa when shapes fit the tiling."""
     if attn_mask is not None or (dropout_p and dropout_p > 0.0):
         return False
+    if getattr(q, "ndim", 0) != 4 or getattr(k, "ndim", 0) != 4 or getattr(v, "ndim", 0) != 4:
+        return False
     shapes_ok = (
-        getattr(q, "ndim", 0) == 4
-        and q.shape[-1] <= 512  # any head dim (zero-padded to the 128 lane)
+        q.shape[-1] <= 512  # any head dim (zero-padded to the 128 lane)
         and q.shape[-2] % DEFAULT_BLOCK_Q == 0
         and k.shape[-2] % DEFAULT_BLOCK_K == 0
         and q.shape[-2] == k.shape[-2]
+        # The kernel grid is (B, q_heads, ...) and k/v BlockSpecs index by q's
+        # head id, so GQA/MQA (fewer k/v heads) or mismatched batch/head-dim
+        # shapes must stay on the composite sdpa path.
+        and q.shape[:2] == k.shape[:2] == v.shape[:2]
+        and q.shape[-1] == k.shape[-1] == v.shape[-1]
+        and k.shape[-2] == v.shape[-2]
     )
     return bool(shapes_ok)
 
@@ -281,7 +288,7 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=
 # symbol registration: claims ltorch.sdpa whole ------------------------------
 
 
-def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
     o, _ = flash_attention_forward(q, k, v, causal=is_causal, scale=scale)
     return o
 
@@ -293,7 +300,7 @@ def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, sc
 _sdpa_jitted = jax.jit(_sdpa_flash_impl, static_argnames=("dropout_p", "is_causal", "scale"))
 
 
-def _sdpa_claimed(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+def _sdpa_claimed(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
     try:
         return _sdpa_jitted(q, k, v, attn_mask,
                             float(dropout_p), bool(is_causal),
@@ -315,12 +322,12 @@ def _register_sdpa_grad_rule():
     decomposition when the kernel can't claim the shapes."""
     from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
 
-    def fwd_meta(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    def fwd_meta(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
         o = TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device)
         lse = TensorProxy(shape=q.shape[:-1], dtype=dtypes.float32, device=q.device)
         return o, lse
 
-    def fwd_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    def fwd_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
         return flash_attention_forward(q, k, v, causal=is_causal, scale=scale)
 
     flash_fwd_sym = Symbol("flash_attention_fwd", fwd_meta, id="pallas.flash_attention_fwd",
@@ -340,7 +347,7 @@ def _register_sdpa_grad_rule():
     ex.opmap[flash_bwd_sym.id] = bwd_impl
 
     @register_augmented_forward("torch.nn.functional.scaled_dot_product_attention")
-    def _sdpa_aug(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    def _sdpa_aug(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
         if not flash_attention_supported(q, k, v, attn_mask, dropout_p, is_causal, scale):
             return NotImplemented
         o, lse = flash_fwd_sym(q, k, v, attn_mask, dropout_p, is_causal, scale)

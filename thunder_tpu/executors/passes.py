@@ -85,4 +85,8 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     for ex in executors:
         if isinstance(ex, FusionExecutor) or ex.is_fusion_executor():
             claimed = ex.fusion_pass(claimed)
-    return claimed
+    # eager frees for op-by-op execution (reference passes.py:261); fused
+    # regions don't need it but the DELs between them are harmless
+    from ..core.transform_common import del_last_used
+
+    return del_last_used(claimed)

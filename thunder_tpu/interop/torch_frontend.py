@@ -175,24 +175,9 @@ def _rms_norm(input, normalized_shape, weight=None, eps=None):
 
 @_register(F.scaled_dot_product_attention)
 def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
-    # GQA: expand KV heads to match query heads (torch does this internally
-    # when enable_gqa=True; HF relies on it for num_key_value_heads < heads).
-    # Only 4-D (B, H, S, D) inputs with divisible head counts are GQA; 3-D
-    # sdpa with differing q/kv lengths is ordinary cross-attention.
-    if q.ndim == 4 and k.ndim == 4:
-        qh, kh = int(q.shape[1]), int(k.shape[1])
-        if qh != kh and kh > 0 and qh % kh == 0:
-            rep = qh // kh
-            k = _repeat_kv(k, rep)
-            v = _repeat_kv(v, rep)
-    return ltorch.sdpa(q, k, v, attn_mask, dropout_p, is_causal, scale)
-
-
-def _repeat_kv(t, rep: int):
-    b, h, s, d = (int(x) for x in t.shape)
-    t = clang.unsqueeze(t, 2)
-    t = clang.expand(t, (b, h, rep, s, d))
-    return clang.reshape(t, (b, h * rep, s, d))
+    # GQA head replication lives in ltorch.sdpa (gated on enable_gqa, matching
+    # torch's semantics — mismatched head counts without the flag raise).
+    return ltorch.sdpa(q, k, v, attn_mask, dropout_p, is_causal, scale, enable_gqa=enable_gqa)
 
 
 @_register(F.cross_entropy)

@@ -106,7 +106,13 @@ class Module:
 
     # --- state dict ---
     def state_dict(self) -> dict:
-        out = {name: p.data for name, p in self.named_parameters()}
+        # _padded_dim0 marks FSDP-padded storage; state_dict round-trips the
+        # original (unpadded) tensor (reference _shard_params padding,
+        # thunder/distributed/__init__.py:508-546)
+        out = {}
+        for name, p in self.named_parameters():
+            orig = getattr(p, "_padded_dim0", None)
+            out[name] = p.data[:orig] if orig is not None else p.data
         out.update({name: b for name, b in self.named_buffers()})
         return out
 
@@ -115,7 +121,13 @@ class Module:
         own_buffers = dict(self.named_buffers())
         for k, v in sd.items():
             if k in own_params:
-                own_params[k].data = jnp.asarray(v)
+                p = own_params[k]
+                v = jnp.asarray(v)
+                orig = getattr(p, "_padded_dim0", None)
+                if orig is not None and v.shape[0] == orig:
+                    pad = [(0, p.data.shape[0] - orig)] + [(0, 0)] * (v.ndim - 1)
+                    v = jnp.pad(v, pad)
+                p.data = v
             elif k in own_buffers:
                 self._set_buffer_by_path(k, jnp.asarray(v))
             elif strict:

@@ -785,10 +785,23 @@ def rms_norm(a, normalized_shape, weight=None, eps=1e-6):
 
 
 @torchsymbol(name="sdpa", id="torch.nn.functional.scaled_dot_product_attention")
-def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
     """Scaled dot-product attention (composite; Pallas flash-attention executor
     claims this symbol whole — reference analog: sdpaex/cudnnex claiming,
     thunder/executors/sdpaex.py:1)."""
+    if q.ndim == 4 and k.ndim == 4 and q.shape[1] != k.shape[1]:
+        check(k.shape[1] == v.shape[1],
+              lambda: f"k has {k.shape[1]} heads but v has {v.shape[1]}")
+        if k.shape[1] != 1:
+            # GQA: replicate k/v head groups to match q (torch enable_gqa=True).
+            # Size-1 kv heads need no flag or replication — matmul broadcasting
+            # covers them, matching torch's math-path semantics.
+            check(enable_gqa, lambda: f"q has {q.shape[1]} heads but k/v have "
+                  f"{k.shape[1]}; pass enable_gqa=True for grouped-query attention")
+            check(q.shape[1] % k.shape[1] == 0,
+                  lambda: f"GQA requires q heads {q.shape[1]} divisible by kv heads {k.shape[1]}")
+            k = repeat_interleave(k, q.shape[1] // k.shape[1], 1)
+            v = repeat_interleave(v, q.shape[1] // v.shape[1], 1)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     kt = clang.matrix_transpose(k)
@@ -1338,7 +1351,10 @@ def tile(a, *dims):
         out = clang.unsqueeze(out, 0)
     dims = (1,) * (out.ndim - len(dims)) + tuple(pyval(d) for d in dims)
     for i, d in enumerate(dims):
-        if d > 1:
+        check(d >= 0, lambda: f"tile: negative repeat {d} for dim {i}")
+        if d == 0:
+            out = clang.slice_in_dim(out, 0, 0, i)
+        elif d > 1:
             out = clang.cat([out] * d, i)
     return out
 

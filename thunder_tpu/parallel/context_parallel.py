@@ -128,8 +128,15 @@ class ContextParallelTransform(Transform):
     def transform_traces_pre_autodiff(self, prologue_trc, computation_trc, *, compile_data=None):
         axis, n = self.axis, self.world_size
 
-        def repl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+        def repl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
             assert attn_mask is None, "context parallel sdpa does not support explicit masks yet"
+            if q.ndim == 4 and k.ndim == 4 and q.shape[1] != k.shape[1]:
+                # replicate GQA/MQA kv heads before the ring: ring_attention's
+                # einsum needs matching head counts (no broadcast)
+                from ..ops import ltorch as _lt
+
+                k = _lt.repeat_interleave(k, q.shape[1] // k.shape[1], 1)
+                v = _lt.repeat_interleave(v, q.shape[1] // v.shape[1], 1)
             return ring_attention(q, k, v, axis=axis, causal=is_causal, scale=scale, world_size=n)
 
         new_trc = substitute_symbols(

@@ -34,6 +34,11 @@ from .mesh import DP_AXIS, FSDP_AXIS, TP_AXIS, axis_size
 class ParamStrategy:
     kind: str  # 'replicate' | 'shard0' | 'column' | 'row'
     axis: str
+    # FSDP extras: orig_dim0 set when dim 0 was padded to divide the axis
+    # (reference thunder/distributed/__init__.py:508-546); zero selects the
+    # re-gather policy (3: backward re-gathers, 2: gathered param saved)
+    orig_dim0: Optional[int] = None
+    zero: int = 3
 
     @property
     def dist_type(self) -> DistParallelType:
@@ -129,8 +134,17 @@ def _set_plan(tmodule: ThunderModule, plan: DistPlan) -> None:
 
 def _place_params(tmodule: ThunderModule, plan: DistPlan) -> None:
     """Physically shard parameter storage per plan (reference _shard_params,
-    thunder/distributed/__init__.py:462)."""
+    thunder/distributed/__init__.py:462), zero-padding indivisible dim-0
+    sizes first (:508-546)."""
+    import jax.numpy as jnp
+
     for name, p in tmodule.get_parameters().items():
+        for st in plan.param_strategies.get(name, ()):
+            if st.kind == "shard0" and st.orig_dim0 is not None and p.data.shape[0] == st.orig_dim0:
+                n = plan.world_size(st.axis)
+                padded = -(-st.orig_dim0 // n) * n
+                p.data = jnp.pad(p.data, [(0, padded - st.orig_dim0)] + [(0, 0)] * (p.data.ndim - 1))
+                p._padded_dim0 = st.orig_dim0
         spec = plan.param_spec(name, p.data.ndim)
         try:
             p.data = jax.device_put(p.data, NamedSharding(plan.mesh, spec))
@@ -158,20 +172,27 @@ def fsdp(
     mesh: Mesh,
     *,
     axis: str = FSDP_AXIS,
-    min_shard_numel: int = 1024,
+    min_shard_numel: int = 128,
+    zero: int = 3,
 ) -> ThunderModule:
-    """ZeRO-3 sharded data parallel (reference thunder.distributed.fsdp,
+    """ZeRO-sharded data parallel (reference thunder.distributed.fsdp,
     thunder/distributed/__init__.py:382): each param dim-0 sharded over
-    `axis`; all-gather before use, grads reduce-scattered; small or
-    indivisible params stay replicated (the reference pads instead,
-    __init__.py:508 — divisibility-or-replicate keeps XLA shapes static)."""
+    `axis` — indivisible dim-0 sizes are zero-padded to the next multiple and
+    unpadded after the gather (reference __init__.py:508-546). ``zero=3``
+    re-gathers params in the backward (peak memory = shards + activations);
+    ``zero=2`` keeps the gathered params alive for the backward (one gather
+    per step, reference FSDPType.ZERO2, __init__.py:324). Grads are
+    reduce-scattered either way. Scalars/tiny params stay replicated."""
+    if zero not in (2, 3):
+        raise ValueError(f"zero must be 2 or 3, got {zero!r}")
     plan = _get_plan(tmodule) or DistPlan(mesh)
     n = axis_size(mesh, axis)
     new = DistPlan(mesh, {}, (axis,))
     for name, p in tmodule.get_parameters().items():
         shape = tuple(p.data.shape)
-        if len(shape) >= 1 and shape[0] % n == 0 and p.data.size >= min_shard_numel:
-            new.param_strategies[name] = [ParamStrategy("shard0", axis)]
+        if len(shape) >= 1 and p.data.size >= min_shard_numel:
+            orig = None if shape[0] % n == 0 else shape[0]
+            new.param_strategies[name] = [ParamStrategy("shard0", axis, orig_dim0=orig, zero=zero)]
         else:
             new.param_strategies[name] = [ParamStrategy("replicate", axis)]
     plan = plan.merge(new)
@@ -184,12 +205,29 @@ def fsdp(
 def apply_param_collectives(params: dict, plan: DistPlan) -> dict:
     """Trace-time: turn device-local param proxies into full params via the
     plan's collective chain (the analog of the reference's `synchronize`
-    insertion at param-use sites, fsdp_v2.py:87)."""
+    insertion at param-use sites, fsdp_v2.py:87).
+
+    ZeRO-3 tags the gather (and unpad slice) RECOMPUTE_IN_BACKWARD so the
+    fwd/bwd split re-gathers in the backward instead of saving the full
+    param — the re-gather semantics of reference fsdp_v2 + ZeRO3."""
+    from ..core.symbol import OpTags
+    from ..core.trace import get_tracectx
+
     full = {}
     for k, v in params.items():
         for st in plan.param_strategies.get(k, ()):
             if st.kind == "shard0":
+                trc = get_tracectx()
+                scope = trc.scopes[-1] if trc is not None else None
+                start = len(scope) if scope is not None else 0
                 v = dist_prims.all_gather(v, st.axis, world_size=plan.world_size(st.axis))
+                if st.orig_dim0 is not None:
+                    from ..ops import clang
+
+                    v = clang.slice_in_dim(clang.ensure_proxy(v), 0, st.orig_dim0, 0)
+                if st.zero == 3 and scope is not None:
+                    for b in scope[start:]:
+                        b.tags.add(OpTags.RECOMPUTE_IN_BACKWARD)
             elif st.kind == "replicate":
                 v = dist_prims.synchronize(v, st.axis)
             # column/row params stay local: TP layers consume local shards

@@ -824,6 +824,79 @@ def _is_diff_dtype(p) -> bool:
     return isinstance(p, TensorProxy) and p.dtype.is_inexact
 
 
+def _plan_recompute(fwd: TraceCtx, saved: list, recompute_names: set):
+    """Shrink the saved-for-backward list by re-deriving tagged residuals.
+
+    Returns (kept_saved, subgraph): subgraph is the minimal ordered list of fwd
+    bsyms whose replay in the backward reproduces every dropped residual;
+    external inputs the subgraph needs are appended to kept_saved (saving a
+    trace *arg* costs nothing — the array is alive regardless)."""
+    if not recompute_names:
+        return saved, []
+    produced: dict[str, Any] = {}
+    for b in fwd.bound_symbols:
+        for o in b.flat_proxy_outs():
+            produced[o.name] = b
+
+    targets = {s.name for s in saved
+               if isinstance(s, TensorProxy) and s.name in recompute_names and s.name in produced}
+    if not targets:
+        return saved, []
+
+    need = set(targets)
+    subgraph: list = []
+    for b in reversed(fwd.bound_symbols):
+        outs = [o.name for o in b.flat_proxy_outs()]
+        if not outs or not any(o in need for o in outs):
+            continue
+        if all(o in recompute_names for o in outs):
+            subgraph.append(b)
+            for p in b.flat_proxy_args():
+                need.add(p.name)
+    subgraph.reverse()
+
+    recomputed = {o.name for b in subgraph for o in b.flat_proxy_outs()}
+    # proxies the subgraph consumes but does not itself produce must be saved
+    external = []
+    ext_seen = set()
+    for b in subgraph:
+        for p in b.flat_proxy_args():
+            if p.name not in recomputed and p.name not in ext_seen:
+                ext_seen.add(p.name)
+                external.append(p)
+
+    kept = [s for s in saved if s.name not in targets]
+    kept_names = {s.name for s in kept}
+    for p in external:
+        if p.name not in kept_names:
+            kept_names.add(p.name)
+            kept.append(p)
+    return kept, subgraph
+
+
+def res_lookup_early(x, saved_mirror: dict):
+    """Map fwd proxies to their bwd mirrors (recompute replay)."""
+    if isinstance(x, Proxy):
+        return saved_mirror.get(x.name, x)
+    if isinstance(x, (tuple, list)):
+        return type(x)(res_lookup_early(e, saved_mirror) for e in x)
+    if isinstance(x, dict):
+        return {k: res_lookup_early(v, saved_mirror) for k, v in x.items()}
+    return x
+
+
+def _map_into(old, new, saved_mirror: dict):
+    if isinstance(old, Proxy):
+        saved_mirror[old.name] = new
+        return
+    if isinstance(old, (tuple, list)) and isinstance(new, (tuple, list)):
+        for o, n in zip(old, new):
+            _map_into(o, n, saved_mirror)
+    elif isinstance(old, dict) and isinstance(new, dict):
+        for k in old:
+            _map_into(old[k], new[k], saved_mirror)
+
+
 class ForwardBackwardTraces(NamedTuple):
     forward_trace: TraceCtx
     backward_trace: TraceCtx
@@ -851,6 +924,9 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
     diff: set[str] = set(grad_arg_names)
     tape: list[TapeEntry] = []
     fwd_output = None
+    # proxies produced while processing RECOMPUTE_IN_BACKWARD-tagged bsyms:
+    # eligible to be re-derived in the backward instead of saved
+    recompute_names: set[str] = set()
 
     def lookup(x):
         if isinstance(x, Proxy):
@@ -874,7 +950,20 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
             for k in old:
                 map_out(old[k], new[k])
 
-    def process(bsym: BoundSymbol):
+    def process(bsym: BoundSymbol, in_recompute: bool = False):
+        from ..core.symbol import OpTags
+
+        tagged = in_recompute or (OpTags.RECOMPUTE_IN_BACKWARD in getattr(bsym, "tags", ()))
+        scope_start = len(fwd.bound_symbols)
+        try:
+            _process_inner(bsym, tagged)
+        finally:
+            if tagged:
+                for nb in fwd.bound_symbols[scope_start:]:
+                    for o in nb.flat_proxy_outs():
+                        recompute_names.add(o.name)
+
+    def _process_inner(bsym: BoundSymbol, in_recompute: bool):
         nonlocal fwd_output
         if bsym.sym.id == PrimIDs.RETURN:
             fwd_output = lookup(bsym.args[0] if len(bsym.args) == 1 else bsym.args)
@@ -903,7 +992,7 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
             return
         if needs_grad and out_is_diff and bsym.subsymbols:
             for sub in bsym.subsymbols:
-                process(sub)
+                process(sub, in_recompute)
             # map composite outputs: subsymbol processing populated env for
             # the proxies the composite returns
             map_out(bsym.output, lookup(bsym.output))
@@ -947,6 +1036,7 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
                 if isinstance(r, Proxy) and r.name not in seen:
                     seen.add(r.name)
                     saved.append(r)
+        saved, recompute_subgraph = _plan_recompute(fwd, saved, recompute_names)
         prims.python_return((fwd_output, tuple(saved)))
 
     fwd_out_tensors = _flat_tensors(fwd_output)
@@ -975,6 +1065,29 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
                 cot_map[o.name] = c
                 bwd_args.append(c)
         bwd.args = tuple(bwd_args)
+
+        # lazy replay of checkpointed segments: each tagged residual is
+        # re-derived right before its first consuming grad rule, so (e.g.)
+        # ZeRO-3 re-gathers keep only one layer's full params alive at a time
+        # (reference: RECOMPUTE_IN_BACKWARD handling in the fwd/bwd split,
+        # thunder/core/jit_ext.py:1080 + symbol.py:99)
+        recompute_producer: dict[str, Any] = {}
+        for rb in recompute_subgraph:
+            for o in rb.flat_proxy_outs():
+                recompute_producer[o.name] = rb
+        _replayed: set = set()
+
+        def materialize(name: str):
+            rb = recompute_producer.get(name)
+            if rb is None or id(rb) in _replayed or name in saved_mirror:
+                return
+            _replayed.add(id(rb))
+            for p in rb.flat_proxy_args():
+                materialize(p.name)
+            rmargs = tuple(res_lookup_early(a, saved_mirror) for a in rb.args)
+            rmkwargs = {k: res_lookup_early(v, saved_mirror) for k, v in rb.kwargs.items()}
+            new_out = rb.sym(*rmargs, **rmkwargs)
+            _map_into(rb.output, new_out, saved_mirror)
 
         grad_map: dict[str, Proxy] = dict(cot_map)
 
@@ -1009,6 +1122,9 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
                 continue
             # fill missing cotangents with zeros for multi-output rules
             cots = [c for c, o in zip(cots, entry.outputs) if _is_diff_dtype(o) or c is not None]
+            for r in entry.residuals:
+                if isinstance(r, Proxy):
+                    materialize(r.name)
             if entry.fallback_impl is not None:
                 res = res_lookup(entry.residuals[0])
                 meta_spec = tuple((p.shape, p.dtype, p.device) for p in entry.inputs)

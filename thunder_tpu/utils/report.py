@@ -166,6 +166,67 @@ def opbyop_callable(cfn):
     return ns[trace.name_of_fn()], trace
 
 
+def _bind_trace_inputs(cfn, trace, args, kwargs) -> list:
+    """Bind concrete values to the trace's positional args by spec matching.
+
+    Candidates are the flattened call args/kwargs plus any prologue-captured
+    parameters (modules capture them outside the call signature). Each trace
+    arg is matched by name first, then by (shape, dtype) against the unused
+    remainder — positional slicing silently mis-binds when captures or kwarg
+    ordering shuffle the flat list."""
+    from ..core.dtypes import to_jax_dtype
+    from ..core.proxies import NumberProxy, TensorProxy
+
+    def _unwrap(v):
+        return getattr(v, "data", v) if type(v).__name__ == "Parameter" else v
+
+    named: dict[str, Any] = {k: v for k, v in kwargs.items()
+                             if hasattr(v, "shape") or isinstance(v, (int, float, bool))}
+    getp = getattr(cfn, "get_parameters", None)
+    if callable(getp):
+        named.update({k: _unwrap(v) for k, v in getp().items()})
+    # pool = call args + params; kwargs are reachable by name AND in the pool,
+    # so a name match must consume the pool entry too (identity scan below)
+    pool: list[Any] = [v for v in jax.tree_util.tree_leaves(args)
+                       if hasattr(v, "shape") or isinstance(v, (int, float, bool))]
+    pool += list(named.values())
+    used = [False] * len(pool)
+    import numpy as np
+
+    pool_dtype = [np.dtype(v.dtype) if hasattr(v, "dtype") else None for v in pool]
+
+    def _consume(val):
+        for i, v in enumerate(pool):
+            if not used[i] and v is val:
+                used[i] = True
+                break
+
+    bound = []
+    for p in trace.args:
+        cand = named.get(p.name)
+        if cand is not None:
+            _consume(cand)
+        if cand is None and isinstance(p, TensorProxy):
+            want_shape, want_dt = tuple(p.shape), np.dtype(to_jax_dtype(p.dtype))
+            for i, v in enumerate(pool):
+                if used[i] or not hasattr(v, "shape"):
+                    continue
+                if tuple(v.shape) == want_shape and pool_dtype[i] == want_dt:
+                    cand, used[i] = v, True
+                    break
+        elif cand is None and isinstance(p, NumberProxy):
+            for i, v in enumerate(pool):
+                # exact python-type match (bool is an int subclass: check first)
+                if not used[i] and not hasattr(v, "shape") and type(v) is p.python_type:
+                    cand, used[i] = v, True
+                    break
+        if cand is None:
+            raise ValueError(f"could not bind trace arg {p.name!r} "
+                             f"({getattr(p, 'shape', None)}) to any call input")
+        bound.append(cand)
+    return bound
+
+
 def timing_report(cfn, *args, iters: int = 10, warmup: int = 2,
                   compare_opbyop: bool = True, **kwargs) -> dict:
     """Compare the compiled function against op-by-op execution of the same
@@ -188,14 +249,13 @@ def timing_report(cfn, *args, iters: int = 10, warmup: int = 2,
     if compare_opbyop:
         try:
             eager_fn, trace = opbyop_callable(cfn)
-            flat = [a for a in args] + [kwargs[k] for k in kwargs]
-            tensorish = [a for a in flat if hasattr(a, "shape") or isinstance(a, (int, float))]
+            bound = _bind_trace_inputs(cfn, trace, args, kwargs)
             n_eager = max(1, min(iters, 3))
-            eager_out = eager_fn(*tensorish[: len(trace.args)])
+            eager_out = eager_fn(*bound)
             jax.block_until_ready(eager_out)
             t1 = time.perf_counter()
             for _ in range(n_eager):
-                eager_out = eager_fn(*tensorish[: len(trace.args)])
+                eager_out = eager_fn(*bound)
             jax.block_until_ready(eager_out)
             eager_s = (time.perf_counter() - t1) / n_eager
             report["opbyop_ms"] = eager_s * 1e3
