@@ -104,14 +104,81 @@ def test_ddp_no_sync_matches_single_device(rng):
                                np.asarray(net_b.fc.weight.data), atol=1e-5)
 
 
-def test_fsdp_no_sync_rejected(rng):
-    """FSDP grads reduce-scatter per micro-batch; no_sync must refuse."""
+def test_fsdp_no_sync_matches_single_device(rng):
+    """FSDP no_sync: params gathered once per window, micro-steps accumulate
+    full local grads with no collectives, fold reduce-scatters once
+    (reference FSDP no_sync + STASH_GRAD_FOR_FSDP,
+    thunder/distributed/__init__.py:36,108-115)."""
     from thunder_tpu.parallel import fsdp, make_mesh
 
+    batches = _batches(rng)
+
+    net_a = _Net()
+    tm_a = tt.jit(net_a)
+    fsdp(tm_a, make_mesh({"fsdp": 4}), min_shard_numel=1)
+    step_a = TrainStep(tm_a, optim.AdamW(lr=0.05))
+    with tm_a.no_sync():
+        step_a(*batches[0])
+        step_a(*batches[1])
+    step_a(*batches[2])
+
+    net_b = _Net()
+    step_b = TrainStep(tt.jit(net_b), optim.AdamW(lr=0.05))
+    tm_b = step_b.tmodule
+    with tm_b.no_sync():
+        step_b(*batches[0])
+        step_b(*batches[1])
+    step_b(*batches[2])
+
+    np.testing.assert_allclose(np.asarray(net_b.fc.weight.data),
+                               np.asarray(net_a.fc.weight.data), atol=1e-5)
+
+
+def test_fsdp_no_sync_micro_steps_do_not_communicate():
+    """The compiled FSDP micro-step program must contain no gradient
+    collectives (that is the point of no_sync) — only the scalar loss psum."""
+    from thunder_tpu.parallel import fsdp, make_mesh
+
+    rng = np.random.RandomState(1)
     net = _Net()
     tm = tt.jit(net)
     fsdp(tm, make_mesh({"fsdp": 4}), min_shard_numel=1)
-    step = TrainStep(tm, optim.AdamW(lr=0.1))
-    with pytest.raises(NotImplementedError):
-        with tm.no_sync():
-            step(jnp.zeros((4, 8), jnp.float32), jnp.zeros((4, 4), jnp.float32))
+    step = TrainStep(tm, optim.AdamW(lr=0.05))
+    x = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    y = jnp.asarray(rng.rand(4, 4).astype(np.float32))
+    with tm.no_sync():
+        step(x, y)
+    # the micro vag traces must contain no collectives
+    bwd_src = step._vag_full._cs.last_backward_traces[0].python()
+    assert "reduce_scatter" not in bwd_src and "all_gather" not in bwd_src
+    step(x, y)  # fold step closes the window
+
+
+def test_2d_ddp_fsdp_no_sync_matches_single_device(rng):
+    """Mixed dp x fsdp plan: the fold must sum grads over the dp axis AND
+    reduce-scatter over the fsdp axis — missing either silently diverges the
+    dp replicas (regression test for exactly that bug)."""
+    from thunder_tpu.parallel import ddp, fsdp, make_mesh
+
+    batches = _batches(rng)
+
+    net_a = _Net()
+    tm_a = tt.jit(net_a)
+    mesh = make_mesh({"dp": 2, "fsdp": 2})
+    ddp(tm_a, mesh)
+    fsdp(tm_a, mesh, min_shard_numel=1)
+    step_a = TrainStep(tm_a, optim.AdamW(lr=0.05))
+    with tm_a.no_sync():
+        step_a(*batches[0])
+        step_a(*batches[1])
+    step_a(*batches[2])
+
+    net_b = _Net()
+    step_b = TrainStep(tt.jit(net_b), optim.AdamW(lr=0.05))
+    with step_b.tmodule.no_sync():
+        step_b(*batches[0])
+        step_b(*batches[1])
+    step_b(*batches[2])
+
+    np.testing.assert_allclose(np.asarray(net_b.fc.weight.data),
+                               np.asarray(net_a.fc.weight.data), atol=1e-5)

@@ -57,32 +57,46 @@ def test_checker_accepts_gpt2_shapes():
             self.shape = shape
             self.ndim = len(shape)
 
-    q = FakeProxy((8, 12, 1024, 64))
+    q = FakeProxy((2, 12, 4096, 64))
     assert pallasex.flash_attention_supported(q, q, q, None, 0.0, True, None)
+    # short sequences stay on the composite path (XLA wins on-chip, measured)
+    q_short = FakeProxy((8, 12, 1024, 64))
+    assert not pallasex.flash_attention_supported(q_short, q_short, q_short, None, 0.0, True, None)
     # unaligned sequence length stays on the composite path
-    q_bad = FakeProxy((8, 12, 100, 64))
+    q_bad = FakeProxy((8, 12, 4100, 64))
     assert not pallasex.flash_attention_supported(q_bad, q_bad, q_bad, None, 0.0, True, None)
     # GQA/MQA (fewer k/v heads) must fall back: the kernel grid indexes k/v
     # blocks by q's head id
-    kv = FakeProxy((8, 4, 1024, 64))
+    kv = FakeProxy((2, 4, 4096, 64))
     assert not pallasex.flash_attention_supported(q, kv, kv, None, 0.0, True, None)
     # mismatched head dim / kv seq len also fall back
-    v_bad = FakeProxy((8, 12, 1024, 128))
+    v_bad = FakeProxy((2, 12, 4096, 128))
     assert not pallasex.flash_attention_supported(q, q, v_bad, None, 0.0, True, None)
-    k_short = FakeProxy((8, 12, 512, 64))
+    k_short = FakeProxy((2, 12, 512, 64))
     assert not pallasex.flash_attention_supported(q, k_short, k_short, None, 0.0, False, None)
 
 
 def test_sdpa_symbol_claims_flash_end_to_end(rng):
-    """Through tt.jit the pallas executor claims sdpa whole when shapes fit."""
-    B, H, T, D = 2, 2, 128, 64
+    """Through tt.jit the pallas executor claims sdpa whole when shapes fit
+    (long sequences only — short ones stay on XLA's fused composite)."""
+    B, H, T, D = 1, 1, 4096, 64
     q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) for _ in range(3))
-    fn = tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
-    out = np.asarray(fn(q, k, v))
+
+    calls = {"n": 0}
+    orig = pallasex.flash_attention_forward
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    pallasex.flash_attention_forward = spy
+    try:
+        fn = tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
+        out = np.asarray(fn(q, k, v))
+    finally:
+        pallasex.flash_attention_forward = orig
+    assert calls["n"] >= 1
     np.testing.assert_allclose(out, np.asarray(_ref_attn(q, k, v)), atol=2e-3)
-    # the claimed symbol should appear (not decomposed into matmul/softmax)
-    names = [b.sym.name for trc in tt.last_traces(fn) for b in trc.bound_symbols]
-    assert any("sdpa" in n for n in names)
 
 
 def test_fused_cross_entropy_matches(rng):

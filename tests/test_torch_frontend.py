@@ -100,13 +100,21 @@ def test_hf_gpt2_matches_eager():
     np.testing.assert_allclose(np.asarray(logits), ref, atol=1e-4)
 
 
-def test_unmapped_op_errors_loudly():
+def test_fft_routes_to_auto_catalog():
+    """torch.fft/linalg/special route to the auto-registered jax catalog
+    (no eager fallback, no error — reference default_torch_ops.py role)."""
+    import warnings
+
     class Weird(tnn.Module):
         def forward(self, x):
             return torch.fft.fft(x).real
 
-    with pytest.raises(Exception):
-        compile_torch_module(Weird())(jnp.ones((4,), jnp.float32))
+    x = torch.randn(4)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = compile_torch_module(Weird())(jnp.asarray(x.numpy()))
+    assert not any("eagerly" in str(m.message) for m in w)
+    np.testing.assert_allclose(np.asarray(out), torch.fft.fft(x).real.numpy(), atol=1e-4)
 
 
 def test_hf_llama_gqa_matches_eager():
@@ -192,3 +200,37 @@ def test_torch_losses_and_unary_surface(rng):
     want = float(m(a, b))
     got = float(tt.jit(m)(a, b))
     np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_unmapped_op_eager_fallback():
+    """An op with no frontend mapping runs eagerly in torch on host instead of
+    raising (the graph-split fallback role of reference dynamo/splitter.py:50);
+    gradients flow through it via torch.func.vjp."""
+    import warnings
+
+    class Exotic(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(8, 8)
+
+        def forward(self, x):
+            h = self.lin(x)
+            h = torch.linalg.solve_triangular(
+                h + 8 * torch.eye(8), torch.ones(8, 8), upper=False)  # no lowering registered
+            return h.sum()
+
+    m = Exotic()
+    x_t = torch.randn(4, 8, 8)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cm = tt.jit(m)
+        out = cm(jnp.asarray(x_t.numpy()))
+    assert any("solve_triangular" in str(x.message) for x in w)
+    x_ref = x_t.clone().requires_grad_(True)
+    ref = m(x_ref)
+    np.testing.assert_allclose(float(out), float(ref), atol=1e-4)
+
+    ref.backward()
+    loss, grads = tt.value_and_grad(cm)(jnp.asarray(x_t.numpy()))
+    name = next(k for k in grads if k.endswith("lin.weight"))
+    np.testing.assert_allclose(np.asarray(grads[name]), m.lin.weight.grad.numpy(), atol=1e-3)

@@ -45,6 +45,8 @@ _TORCH_TO_TT = {
     torch.int8: tt_dtypes.int8,
     torch.uint8: tt_dtypes.uint8,
     torch.bool: tt_dtypes.bool8,
+    torch.complex64: tt_dtypes.complex64,
+    torch.complex128: tt_dtypes.complex128,
 }
 _TT_TO_TORCH = {v: k for k, v in _TORCH_TO_TT.items()}
 
@@ -717,6 +719,12 @@ def dispatch(func, args, kwargs):
             return _wrap(ltorch.matrix_transpose(p))
         if pname == "T":
             return _wrap(ltorch.t(p))
+        if pname in ("real", "imag"):
+            from ..ops.auto_register import get_auto_symbol
+
+            if pname == "real" and not p.dtype.is_complex:
+                return t
+            return _wrap(get_auto_symbol(pname)(p))
         raise NotImplementedError(f"torch frontend: tensor property '{pname}' not mapped")
     # metadata accessors
     meta_fn = _PASSTHROUGH_META.get(func)
@@ -730,14 +738,223 @@ def dispatch(func, args, kwargs):
     if impl is None and name in _GENERIC_NAMES:
         impl = getattr(ltorch, name, None)
     if impl is None:
-        raise NotImplementedError(
-            f"torch frontend: no mapping for {getattr(func, '__module__', '?')}.{name} — "
-            f"register one in thunder_tpu/interop/torch_frontend.py"
-        )
+        # auto-registered catalog (jax-lowered long tail: fft/linalg/special)
+        impl = _auto_catalog_lookup(func, name)
+    if impl is None:
+        # no mapping: fall back to running the torch op eagerly on host
+        # (the graph-split fallback role of reference
+        # thunder/dynamo/splitter.py:50 — here per-op via pure_callback, so
+        # the surrounding program still compiles as one XLA computation)
+        impl = _eager_fallback_symbol(func, name)
     uargs = _unwrap(args)
     ukwargs = _unwrap(kwargs)
     out = impl(*uargs, **ukwargs)
     return _wrap(out)
+
+
+# ---------------------------------------------------------------------------
+# eager fallback for unmapped torch ops
+# ---------------------------------------------------------------------------
+
+def _auto_catalog_lookup(func, name: str):
+    """Map a torch callable to an auto-registered jax symbol by qualified
+    name (torch.fft.fft -> auto.fft_fft, torch.linalg.inv -> auto.linalg_inv,
+    torch.special.* -> auto.special_*, plain torch.<name> -> auto.<name>)."""
+    from ..ops.auto_register import get_auto_symbol
+
+    mod = getattr(func, "__module__", "") or ""
+    keys = [name]
+    for fam in ("fft", "linalg", "special"):
+        if mod.endswith(fam):
+            keys.insert(0, f"{fam}_{name}")
+    for key in keys:
+        sym = get_auto_symbol(key)
+        if sym is not None:
+            return sym
+    return None
+
+
+_eager_symbols: dict = {}
+_eager_warned: set = set()
+
+
+def _split_arrays(args, kwargs):
+    """Separate array-valued leaves (proxies at meta time, jax arrays/tracers
+    at run time) from static structure; returns (arrays, rebuild)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, (TensorProxy, torch.Tensor)))
+    is_arr = [isinstance(l, (TensorProxy, jax.Array, jax.core.Tracer)) for l in leaves]
+    arrays = [l for l, m in zip(leaves, is_arr) if m]
+
+    def rebuild(new_arrays):
+        it = iter(new_arrays)
+        new = [next(it) if m else l for l, m in zip(leaves, is_arr)]
+        args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, new)
+        return args2, kwargs2
+
+    return arrays, rebuild
+
+
+def _np_to_torch(a):
+    arr = np.asarray(a)
+    if arr.dtype.name in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        arr = arr.astype(np.float32)  # numpy<->torch bridge lacks these dtypes
+    elif arr.dtype in (np.int32, np.int16, np.uint8):
+        # jax disables x64 so traced index tensors arrive int32; torch's
+        # index-taking ops require long
+        arr = arr.astype(np.int64)
+    return torch.from_numpy(np.ascontiguousarray(arr))
+
+
+def _meta_result_specs(func, arrays, rebuild):
+    """Run the torch op on meta tensors to learn output shapes/dtypes."""
+    import jax
+
+    metas = []
+    for a in arrays:
+        td = to_torch_dtype(a.dtype if isinstance(a.dtype, tt_dtypes.dtype) else tt_dtypes.to_dtype(a.dtype))
+        if td in (torch.int32, torch.int16, torch.uint8):
+            td = torch.int64  # host bridge upcasts (jax x64 off; torch wants long indices)
+        metas.append(torch.empty(tuple(a.shape), dtype=td, device="meta"))
+    margs, mkwargs = rebuild(metas)
+    out = func(*margs, **mkwargs)
+
+    def to_spec(x):
+        if isinstance(x, torch.Tensor):
+            return jax.ShapeDtypeStruct(tuple(x.shape), jnp.dtype(tt_dtypes.to_jax_dtype(to_tt_dtype(x.dtype))))
+        return x
+
+    return jax.tree_util.tree_map(to_spec, out, is_leaf=lambda x: isinstance(x, torch.Tensor))
+
+
+def _eager_fallback_symbol(func, name: str):
+    """Opaque symbol executing `func` in torch on host (numpy bridge) —
+    jit-compatible via jax.pure_callback; gradients via torch.func.vjp
+    (reference analog: default_torch_ops auto-registration, which keeps
+    unmapped ops on torch eager, thunder/torch/default_torch_ops.py:3)."""
+    import warnings
+
+    import jax
+
+    sym = _eager_symbols.get(func)
+    if sym is not None:
+        return sym
+    if name.endswith("_") and not name.endswith("__"):
+        # in-place torch op: running it on a host copy would silently drop
+        # the mutation — keep the loud error
+        raise NotImplementedError(
+            f"torch frontend: in-place op {name} has no mapping and cannot "
+            f"fall back to host-eager execution (the mutation would be lost); "
+            f"register a functionalized lowering in torch_frontend.py")
+    if func not in _eager_warned:
+        _eager_warned.add(func)
+        warnings.warn(
+            f"torch frontend: no mapping for {getattr(func, '__module__', '?')}.{name}; "
+            f"running it eagerly in torch on host (slow — consider registering a lowering)")
+
+    from ..core.symbol import Symbol
+    from ..ops.auto_register import AUTO_REGISTERED
+
+    sym_id = f"torch_eager.{getattr(func, '__module__', '?')}.{name}"
+
+    def meta(*args, **kwargs):
+        if "out" in kwargs and kwargs["out"] is not None:
+            raise NotImplementedError(
+                f"torch frontend: {name}(..., out=) has no mapping; the "
+                f"host-eager fallback cannot honor out= aliasing")
+        from ..ops.auto_register import _find_device
+
+        device = _find_device((args, kwargs))
+        arrays, rebuild = _split_arrays(args, kwargs)
+        specs = _meta_result_specs(func, arrays, rebuild)
+
+        def to_proxy(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return TensorProxy(shape=tuple(x.shape), dtype=tt_dtypes.to_dtype(x.dtype), device=device)
+            return x
+
+        return jax.tree_util.tree_map(to_proxy, specs)
+
+    def run_impl(*args, **kwargs):
+        arrays, rebuild = _split_arrays(args, kwargs)
+        specs = _meta_result_specs(func, arrays, rebuild)
+
+        @jax.custom_vjp
+        def arr_fn(*arrs):
+            def host(*host_arrs):
+                targs, tkwargs = rebuild([_np_to_torch(a) for a in host_arrs])
+                out = func(*targs, **tkwargs)
+                flat_specs = jax.tree_util.tree_leaves(specs)
+                flat = jax.tree_util.tree_leaves(
+                    out, is_leaf=lambda x: isinstance(x, torch.Tensor))
+                np_out = [np.asarray(x.detach().numpy()).astype(s.dtype)
+                          if isinstance(x, torch.Tensor) else x
+                          for x, s in zip(flat, flat_specs)]
+                return jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(specs), np_out)
+
+            return jax.pure_callback(host, specs, *arrs)
+
+        def arr_fwd(*arrs):
+            return arr_fn(*arrs), arrs
+
+        def arr_bwd(res, cots):
+            import numpy as _np
+
+            flat_cots, _ = jax.tree_util.tree_flatten(cots)
+            grad_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res)
+            # integer/bool arrays (indices etc.) cannot be vjp primals in
+            # torch — close over them, differentiate only the float arrays
+            is_float = [bool(_np.issubdtype(_np.dtype(a.dtype), _np.floating)) for a in res]
+
+            def host_bwd(*host_vals):
+                n = len(res)
+
+                def prep(a):
+                    t = _np_to_torch(a)
+                    return t.float() if t.dtype.is_floating_point else t
+
+                all_t = [prep(a) for a in host_vals[:n]]
+                cot_t = [prep(c) for c in host_vals[n:]]
+                float_t = [t for t, m in zip(all_t, is_float) if m]
+
+                def f_of_floats(*fts):
+                    it = iter(fts)
+                    ts = [next(it) if m else t for t, m in zip(all_t, is_float)]
+                    targs, tkwargs = rebuild(ts)
+                    return func(*targs, **tkwargs)
+
+                out, vjp_fn = torch.func.vjp(f_of_floats, *float_t)
+                cot_tree = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(out, is_leaf=lambda x: isinstance(x, torch.Tensor)),
+                    cot_t)
+                float_grads = iter(vjp_fn(cot_tree))
+                np_grads = []
+                for m, p, spec in zip(is_float, all_t, grad_specs):
+                    g = next(float_grads) if m else None
+                    if g is None:
+                        np_grads.append(np.zeros(tuple(p.shape), dtype=spec.dtype))
+                    else:
+                        np_grads.append(np.asarray(g.detach().numpy()).astype(spec.dtype))
+                return tuple(np_grads)
+
+            gs = jax.pure_callback(host_bwd, grad_specs, *res, *flat_cots)
+            # match primal dtypes (torch vjp ran in float32 for low-precision)
+            return tuple(g.astype(a.dtype) for g, a in zip(gs, res))
+
+        arr_fn.defvjp(arr_fwd, arr_bwd)
+        return arr_fn(*arrays)
+
+    sym = Symbol(name, meta, id=sym_id, module="torch_eager", tags=(AUTO_REGISTERED,))
+    from ..executors import jaxex
+    from ..transforms import autodiff
+
+    jaxex.ex.register_implementation(sym_id, run_impl)
+    autodiff.JAX_VJP_FALLBACK.add(sym_id)
+    _eager_symbols[func] = sym
+    return sym
 
 
 # ---------------------------------------------------------------------------

@@ -196,7 +196,232 @@ _CATALOG_DIFF: dict[str, Callable] = {
     "cov": lambda a: jnp.cov(a),
     "corrcoef": lambda a: jnp.corrcoef(a),
     "vander": lambda x, N=None: jnp.vander(x, N),
+    # wave 3 — blas-style composites (torch.addmm family)
+    "addmm": lambda inp, m1, m2, beta=1.0, alpha=1.0: beta * inp + alpha * (m1 @ m2),
+    "addbmm": lambda inp, b1, b2, beta=1.0, alpha=1.0: beta * inp + alpha * jnp.sum(b1 @ b2, 0),
+    "baddbmm": lambda inp, b1, b2, beta=1.0, alpha=1.0: beta * inp + alpha * (b1 @ b2),
+    "addmv": lambda inp, m, v, beta=1.0, alpha=1.0: beta * inp + alpha * (m @ v),
+    "addr": lambda inp, v1, v2, beta=1.0, alpha=1.0: beta * inp + alpha * jnp.outer(v1, v2),
+    "bmm": lambda a, b: a @ b,
+    "ger": jnp.outer,
+    "inner": jnp.inner,
+    "matrix_exp": jax.scipy.linalg.expm,
+    "linalg_matrix_exp": jax.scipy.linalg.expm,
+    "adjoint": lambda a: jnp.conjugate(jnp.swapaxes(a, -2, -1)),
+    "cholesky_inverse": lambda L, upper=False: jnp.linalg.inv(
+        (L @ jnp.conjugate(jnp.swapaxes(L, -2, -1))) if not upper
+        else (jnp.conjugate(jnp.swapaxes(L, -2, -1)) @ L)),
+    "linalg_cond": lambda a, p=None: jnp.linalg.cond(a, p),
+    "linalg_vector_norm": lambda a, ord=2, dim=None, keepdim=False: jnp.linalg.norm(
+        a, ord=ord, axis=dim, keepdims=keepdim),
+    "linalg_matrix_norm": lambda a, ord="fro", dim=(-2, -1), keepdim=False: jnp.linalg.norm(
+        a, ord=ord, axis=tuple(dim), keepdims=keepdim),
+    "linalg_vecdot": lambda a, b, dim=-1: jnp.sum(jnp.conjugate(a) * b, axis=dim),
+    "linalg_householder_product": lambda a, tau: _householder_product(a, tau),
+    # complex support
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "conj": jnp.conjugate,
+    "conj_physical": jnp.conjugate,
+    "angle": jnp.angle,
+    "view_as_real": lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1),
+    "view_as_complex": lambda a: jax.lax.complex(a[..., 0], a[..., 1]),
+    "complex_build": jax.lax.complex,
+    "polar": lambda r, theta: jax.lax.complex(r * jnp.cos(theta), r * jnp.sin(theta)),
+    # stacking / reshaping long tail
+    "dstack": lambda ts: jnp.dstack(ts),
+    "hstack": lambda ts: jnp.hstack(ts),
+    "vstack": lambda ts: jnp.vstack(ts),
+    "column_stack": lambda ts: jnp.column_stack(ts),
+    "row_stack": lambda ts: jnp.vstack(ts),
+    "atleast_1d": jnp.atleast_1d,
+    "atleast_2d": jnp.atleast_2d,
+    "atleast_3d": jnp.atleast_3d,
+    "swapdims": lambda a, d0, d1: jnp.swapaxes(a, d0, d1),
+    "moveaxis": lambda a, s, d: jnp.moveaxis(a, s, d),
+    "diag_embed": lambda a, offset=0, dim1=-2, dim2=-1: _diag_embed_dims(a, offset, dim1, dim2),
+    "diagflat": lambda a, offset=0: jnp.diagflat(a, offset),
+    "diagonal": lambda a, offset=0, dim1=0, dim2=1: jnp.diagonal(a, offset, dim1, dim2),
+    "diagonal_scatter": lambda a, src, offset=0, dim1=0, dim2=1: _diagonal_scatter(a, src, offset, dim1, dim2),
+    "tril": lambda a, diagonal=0: jnp.tril(a, diagonal),
+    "triu": lambda a, diagonal=0: jnp.triu(a, diagonal),
+    "narrow_copy": lambda a, dim, start, length: jax.lax.slice_in_dim(a, start, start + length, axis=dim),
+    "unfold_dim": lambda a, dim, size, step: _unfold(a, dim, size, step),
+    "pixel_shuffle": lambda a, r: _pixel_shuffle(a, r),
+    "pixel_unshuffle": lambda a, r: _pixel_unshuffle(a, r),
+    "channel_shuffle": lambda a, groups: _channel_shuffle(a, groups),
+    # numerical long tail
+    "nanmedian": lambda a, dim=None, keepdim=False: jnp.nanmedian(
+        a, axis=dim, keepdims=keepdim),
+    "nanquantile": lambda a, q, dim=None, keepdim=False: jnp.nanquantile(
+        a, q, axis=dim, keepdims=keepdim),
+    "quantile": lambda a, q, dim=None, keepdim=False: jnp.quantile(
+        a, q, axis=dim, keepdims=keepdim),
+    "diff": lambda a, n=1, dim=-1: jnp.diff(a, n=n, axis=dim),
+    "trapezoid": lambda y, x=None, dim=-1: jnp.trapezoid(y, x, axis=dim),
+    "cumulative_trapezoid": lambda y, x=None, dim=-1: _cumtrapz(y, x, dim),
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "frexp": jnp.frexp,
+    "nextafter": jnp.nextafter,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "positive": jnp.positive,
+    "float_power": jnp.float_power,
+    "true_divide_": jnp.true_divide,
+    "floor_divide": jnp.floor_divide,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "logit": lambda a, eps=None: jax.scipy.special.logit(
+        jnp.clip(a, eps, 1 - eps) if eps is not None else a),
+    "mvlgamma": lambda a, p: jax.scipy.special.multigammaln(a, p),
+    "special_multigammaln": lambda a, p: jax.scipy.special.multigammaln(a, p),
+    "special_erfcx": lambda a: _erfcx(a),
+    "special_xlog1py": jax.scipy.special.xlog1py,
+    "special_xlogy": jax.scipy.special.xlogy,
+    "special_digamma": jax.scipy.special.digamma,
+    "special_psi": jax.scipy.special.digamma,
+    "special_erf": jax.scipy.special.erf,
+    "special_erfc": jax.scipy.special.erfc,
+    "special_erfinv": jax.scipy.special.erfinv,
+    "special_exp2": jnp.exp2,
+    "special_expm1": jnp.expm1,
+    "special_log1p": jnp.log1p,
+    "special_sinc": jnp.sinc,
+    "special_round": jnp.round,
+    "special_gammaln": jax.scipy.special.gammaln,
+    "igamma": jax.scipy.special.gammainc,
+    "igammac": jax.scipy.special.gammaincc,
+    "cosine_similarity": lambda x1, x2, dim=1, eps=1e-8: jnp.sum(x1 * x2, axis=dim) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=dim) * jnp.linalg.norm(x2, axis=dim), eps),
+    "pairwise_distance": lambda x1, x2, p=2.0, eps=1e-6, keepdim=False: jnp.linalg.norm(
+        x1 - x2 + eps, ord=p, axis=-1, keepdims=keepdim),
+    "cdist": lambda x1, x2, p=2.0: _cdist(x1, x2, p),
+    "normalize_fn": lambda a, p=2.0, dim=1, eps=1e-12: a / jnp.maximum(
+        jnp.linalg.norm(a, ord=p, axis=dim, keepdims=True), eps),
+    # nn.functional long tail (elementwise activations)
+    "elu": lambda a, alpha=1.0: jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+    "selu": jax.nn.selu,
+    "celu": lambda a, alpha=1.0: jax.nn.celu(a, alpha),
+    "glu": lambda a, dim=-1: jax.nn.glu(a, axis=dim),
+    "hardswish": jax.nn.hard_swish,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "hardtanh": lambda a, min_val=-1.0, max_val=1.0: jnp.clip(a, min_val, max_val),
+    "softsign": jax.nn.soft_sign,
+    "tanhshrink": lambda a: a - jnp.tanh(a),
+    "hardshrink": lambda a, lambd=0.5: jnp.where(jnp.abs(a) > lambd, a, 0.0),
+    "softshrink": lambda a, lambd=0.5: jnp.where(
+        a > lambd, a - lambd, jnp.where(a < -lambd, a + lambd, 0.0)),
+    "threshold": lambda a, threshold, value: jnp.where(a > threshold, a, value),
+    "logsigmoid": jax.nn.log_sigmoid,
+    "mish": lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+    "softplus": lambda a, beta=1.0, threshold=20.0: jnp.where(
+        a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+    "prelu": lambda a, weight: _prelu(a, weight),
+    "rrelu_eval": lambda a, lower=0.125, upper=1.0 / 3: jnp.where(
+        a >= 0, a, a * (lower + upper) / 2),
 }
+
+
+def _householder_product(a, tau):
+    m, n = a.shape[-2], a.shape[-1]
+    q = jnp.eye(m, dtype=a.dtype)
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1:, i]])
+        q = q @ (jnp.eye(m, dtype=a.dtype) - tau[i] * jnp.outer(v, v))
+    return q
+
+
+def _diag_embed_dims(a, offset, dim1, dim2):
+    out = _diag_embed(a, offset)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (-2, -1), (d1, d2))
+    return out
+
+
+def _erfcx(a):
+    """Scaled complementary error function, overflow-safe: asymptotic series
+    1/(x sqrt(pi)) (1 - 1/(2x^2) + 3/(4x^4)) for large positive x."""
+    x = a
+    direct = jnp.exp(x * x) * jax.scipy.special.erfc(x)
+    xs = jnp.where(jnp.abs(x) > 6.0, x, 6.0)  # avoid div-by-small in unused lane
+    inv2 = 1.0 / (xs * xs)
+    series = (1.0 - 0.5 * inv2 + 0.75 * inv2 * inv2) / (xs * jnp.sqrt(jnp.pi))
+    return jnp.where(x > 6.0, series, direct)
+
+
+def _prelu(a, weight):
+    if getattr(weight, "ndim", 0) >= 1 and weight.shape[0] > 1 and a.ndim >= 2:
+        # per-channel weight applies along dim 1 (torch semantics)
+        weight = weight.reshape((1, -1) + (1,) * (a.ndim - 2))
+    return jnp.where(a >= 0, a, weight * a)
+
+
+def _diag_embed(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return base.at[..., r, c].set(a)
+
+
+def _diagonal_scatter(a, src, offset, dim1, dim2):
+    a_m = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+    idx = jnp.arange(src.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = a_m.at[..., r, c].set(src)
+    return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+
+
+def _unfold(a, dim, size, step):
+    n = (a.shape[dim] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(a, dim, -1)
+    win = moved[..., idx]  # (..., n, size)
+    return jnp.moveaxis(win, -2, dim)
+
+
+def _pixel_shuffle(a, r):
+    b, c, h, w = a.shape
+    a = a.reshape(b, c // (r * r), r, r, h, w)
+    a = a.transpose(0, 1, 4, 2, 5, 3)
+    return a.reshape(b, c // (r * r), h * r, w * r)
+
+
+def _pixel_unshuffle(a, r):
+    b, c, h, w = a.shape
+    a = a.reshape(b, c, h // r, r, w // r, r)
+    a = a.transpose(0, 1, 3, 5, 2, 4)
+    return a.reshape(b, c * r * r, h // r, w // r)
+
+
+def _channel_shuffle(a, groups):
+    b, c = a.shape[:2]
+    rest = a.shape[2:]
+    return a.reshape(b, groups, c // groups, *rest).swapaxes(1, 2).reshape(a.shape)
+
+
+def _cdist(x1, x2, p):
+    d = x1[..., :, None, :] - x2[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+def _cumtrapz(y, x, dim):
+    import jax.numpy as _j
+
+    yl = jnp.moveaxis(y, dim, -1)
+    avg = (yl[..., 1:] + yl[..., :-1]) / 2
+    if x is not None:
+        dx = jnp.diff(jnp.moveaxis(x, dim, -1) if x.ndim == y.ndim else x)
+        avg = avg * dx
+    return jnp.moveaxis(jnp.cumsum(avg, -1), -1, dim)
 
 _CATALOG_NONDIFF: dict[str, Callable] = {
     "searchsorted": lambda sorted_seq, values, right=False: jnp.searchsorted(

@@ -177,19 +177,27 @@ class TrainStep:
         loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
         return loss
 
-    # -- distributed no_sync (pure-DDP plans) --
+    # -- distributed no_sync (pure-DDP and DDP/FSDP plans) --
     _vag_nosync = None
     _micro_dist_jitted = None
     _fold_dist_jitted = None
+    _acc_mode = None  # 'ddp' (partial grads) | 'fsdp' (full grads, cached gather)
+    _vag_full = None
+    _gather_jitted = None
+    _full_cache = None
+    _micro_fsdp_jitted = None
+    _fold_fsdp_jitted = None
 
     @staticmethod
-    def _check_pure_ddp(plan):
-        for name, sts in plan.param_strategies.items():
-            if any(st.kind != "replicate" for st in sts):
-                raise NotImplementedError(
-                    "no_sync supports pure-DDP (replicate) plans; FSDP/TP "
-                    "gradients synchronize per micro-batch inherently "
-                    "(reduce-scatter is part of the sharded backward)")
+    def _nosync_mode(plan) -> str:
+        kinds = {st.kind for sts in plan.param_strategies.values() for st in sts}
+        if kinds <= {"replicate"}:
+            return "ddp"
+        if kinds <= {"replicate", "shard0"} and not getattr(plan, "seq_axes", ()):
+            return "fsdp"
+        raise NotImplementedError(
+            "no_sync supports DDP (replicate) and FSDP (shard0) plans; "
+            "TP/CP gradients synchronize per micro-batch inherently")
 
     def _dist_specs(self, plan, trainable, frozen, batch_args, batch_kwargs):
         from jax.sharding import PartitionSpec as P
@@ -200,7 +208,9 @@ class TrainStep:
         return param_specs, frozen_specs, acc_specs, args_specs, kwargs_specs
 
     def _micro_step_dist(self, plan, args, kwargs):
-        self._check_pure_ddp(plan)
+        self._acc_mode = self._nosync_mode(plan)
+        if self._acc_mode == "fsdp":
+            return self._micro_step_fsdp(plan, args, kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
         frozen_arrays = {k: p.data for k, p in frozen.items()}
@@ -246,9 +256,156 @@ class TrainStep:
             tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
         return loss
 
+    # -- FSDP no_sync: gather params ONCE per accumulation window, run
+    # micro-steps with zero communication on cached full params, fold with a
+    # single reduce-scatter (reference FSDP no_sync stashes unsharded grads,
+    # thunder/distributed/__init__.py:36 + STASH_GRAD_FOR_FSDP) --
+
+    def _make_vag_full(self):
+        """ValueAndGrad over the raw model with FULL params (no collectives)."""
+        from .transforms.autodiff import ThunderValueAndGrad
+
+        inner = self.tmodule._cfn._cd.fn
+
+        def traced_full(tfull: dict, frozen_full: dict, args: tuple, kwargs: dict):
+            return inner({**frozen_full, **tfull}, args, kwargs)
+
+        traced_full.__name__ = f"nosync_{getattr(inner, '__name__', 'step')}"
+        return ThunderValueAndGrad(traced_full, argnums=0, transforms=self.tmodule._cfn._transforms)
+
+    def _gather_full(self, plan, tparam_arrays, frozen_arrays):
+        """One jitted gather of every sharded param to full (unpadded) form."""
+        if self._gather_jitted is None:
+            from jax.sharding import PartitionSpec as P
+
+            strategies = plan.param_strategies
+
+            def gather_raw(tparams, frozen_a):
+                def full(k, v):
+                    for st in strategies.get(k, ()):
+                        if st.kind == "shard0":
+                            v = jax.lax.all_gather(v, st.axis, tiled=True)
+                            if st.orig_dim0 is not None:
+                                v = v[: st.orig_dim0]
+                    return v
+
+                return ({k: full(k, v) for k, v in tparams.items()},
+                        {k: full(k, v) for k, v in frozen_a.items()})
+
+            pspec = {k: plan.param_spec(k, v.ndim) for k, v in tparam_arrays.items()}
+            fspec = {k: plan.param_spec(k, v.ndim) for k, v in frozen_arrays.items()}
+            out_t = {k: P() for k in tparam_arrays}
+            out_f = {k: P() for k in frozen_arrays}
+            sm = _shard_map_compat(gather_raw, plan.mesh, (pspec, fspec), (out_t, out_f))
+            self._gather_jitted = jax.jit(sm)
+        return self._gather_jitted(tparam_arrays, frozen_arrays)
+
+    def _micro_step_fsdp(self, plan, args, kwargs):
+        trainable, frozen = self._split_params()
+        tparam_arrays = {k: p.data for k, p in trainable.items()}
+        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        if self._jitted is None:
+            if self.opt_state is None:
+                self.opt_state = self.optimizer.init(tparam_arrays)
+            self._build(args, kwargs)
+        if self._vag_full is None:
+            self._vag_full = self._make_vag_full()
+        if self._full_cache is None:
+            self._full_cache = self._gather_full(plan, tparam_arrays, frozen_arrays)
+        full_t, full_f = self._full_cache
+        if self._grad_acc is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def _sharded_zeros(shape, dtype):
+                sh = NamedSharding(plan.mesh, P(plan.loss_axis_name, *([None] * (len(shape) - 1))))
+                return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)()
+
+            self._grad_acc = {k: _sharded_zeros((plan.loss_world_size,) + tuple(v.shape), v.dtype)
+                              for k, v in full_t.items()}
+        if self._micro_fsdp_jitted is None:
+            from jax.sharding import PartitionSpec as P
+
+            vagf = self._vag_full
+            ndev = plan.loss_world_size
+            axes = plan.loss_axis_name
+
+            def micro_raw(tfull, ffull, acc, a, kw):
+                loss_local, grads = vagf(tfull, ffull, a, kw)
+                g = grads[0][0]
+                new_acc = {k: acc[k] + g[k][None] for k in g}
+                loss = jax.lax.psum(loss_local, axes) / ndev
+                return loss, new_acc
+
+            tspec = {k: P() for k in full_t}
+            fspec = {k: P() for k in full_f}
+            aspec = {k: P(plan.loss_axis_name, *([None] * v.ndim)) for k, v in full_t.items()}
+            args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), args)
+            kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), kwargs)
+            sm = _shard_map_compat(micro_raw, plan.mesh,
+                                   (tspec, fspec, aspec, args_specs, kwargs_specs),
+                                   (P(), aspec))
+            self._micro_fsdp_jitted = jax.jit(sm, donate_argnums=(2,) if self.donate else ())
+        loss, self._grad_acc = self._micro_fsdp_jitted(full_t, full_f, self._grad_acc, args, kwargs)
+        return loss
+
+    def _fold_fsdp(self, plan, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
+        """Final step of an FSDP no_sync window: fresh local full grads + the
+        accumulator, ONE reduce-scatter per sharded param, optimizer on
+        shards; the cached full params are then invalidated."""
+        full_t, full_f = self._full_cache
+        if self._fold_fsdp_jitted is None:
+            from jax.sharding import PartitionSpec as P
+
+            vagf = self._vag_full
+            optimizer = self.optimizer
+            ndev = plan.loss_world_size
+            axes = plan.loss_axis_name
+            strategies = plan.param_strategies
+
+            def shard_grad(k, g, shard_like):
+                # full chain: psum over every loss axis the param is NOT
+                # sharded on (dp replicas see different batches), then one
+                # reduce-scatter over its shard axis
+                shard_st = next((st for st in strategies.get(k, ()) if st.kind == "shard0"), None)
+                if shard_st is None:
+                    return jax.lax.psum(g, axes) / ndev
+                other = tuple(a for a in plan.loss_axes if a != shard_st.axis)
+                if other:
+                    g = jax.lax.psum(g, other if len(other) > 1 else other[0])
+                if shard_st.orig_dim0 is not None:
+                    pad = shard_like.shape[0] * plan.world_size(shard_st.axis) - shard_st.orig_dim0
+                    g = jnp.pad(g, [(0, pad)] + [(0, 0)] * (g.ndim - 1))
+                return jax.lax.psum_scatter(g, shard_st.axis, scatter_dimension=0, tiled=True) / ndev
+
+            def fold_raw(tshards, opt_st, tfull, ffull, acc, a, kw):
+                loss_local, grads = vagf(tfull, ffull, a, kw)
+                g = grads[0][0]
+                total = {k: g[k] + acc[k][0] for k in g}
+                gshards = {k: shard_grad(k, total[k], tshards[k]) for k in total}
+                new_params, new_state = optimizer.update(tshards, gshards, opt_st)
+                loss = jax.lax.psum(loss_local, axes) / ndev
+                return loss, new_params, new_state
+
+            pspec = {k: plan.param_spec(k, v.ndim) for k, v in tparam_arrays.items()}
+            opt_specs = _opt_state_specs(opt_state, pspec)
+            tspec = {k: P() for k in full_t}
+            fspec = {k: P() for k in full_f}
+            aspec = {k: P(plan.loss_axis_name, *([None] * v.ndim)) for k, v in full_t.items()}
+            args_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), args)
+            kwargs_specs = jax.tree_util.tree_map(lambda l: _batch_pspec(plan, l), kwargs)
+            sm = _shard_map_compat(fold_raw, plan.mesh,
+                                   (pspec, opt_specs, tspec, fspec, aspec, args_specs, kwargs_specs),
+                                   (P(), pspec, opt_specs))
+            self._fold_fsdp_jitted = jax.jit(sm, donate_argnums=(0, 1, 4) if self.donate else ())
+        out = self._fold_fsdp_jitted(tparam_arrays, opt_state, full_t, full_f, acc, args, kwargs)
+        self._full_cache = None  # params change: next window re-gathers
+        return out
+
     def _fold_dist(self, plan, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
         """Final step of a distributed no_sync window: ONE all-reduce over
         (fresh local grads + accumulated partials), then the optimizer."""
+        if self._acc_mode == "fsdp":
+            return self._fold_fsdp(plan, tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs)
         if self._fold_dist_jitted is None:
             from jax.sharding import PartitionSpec as P
 

@@ -1346,6 +1346,8 @@ def value_and_grad(fn, argnums=None):
         from .. import jit
 
         return ModuleValueAndGrad(jit(fn))
+    if type(fn).__name__ == "CompiledTorchModule":  # torch-frontend wrapper
+        return TorchModuleValueAndGrad(fn)
     if isinstance(fn, ThunderCompiledFunction):
         fn = fn._cd.fn
     return ThunderValueAndGrad(fn, argnums)
@@ -1360,6 +1362,26 @@ def grad(fn, argnums=None):
 
     grad_fn.__wrapped_vag__ = vag
     return grad_fn
+
+
+class TorchModuleValueAndGrad:
+    """value_and_grad over a CompiledTorchModule: (loss, {param_name: grad}).
+
+    The torch-frontend wrapper's traced fn takes (params, args, kwargs) like
+    ThunderModule's; params are plain jax arrays, so argnums=0 marks them."""
+
+    def __init__(self, ctm):
+        self.ctm = ctm
+        self._vag = ThunderValueAndGrad(ctm._cfn._cd.fn, argnums=0)
+
+    @property
+    def _cs(self):
+        return self._vag._cs
+
+    def __call__(self, *args, **kwargs):
+        params = self.ctm.get_parameters()
+        loss, grads = self._vag(params, args, kwargs)
+        return loss, grads[0][0]
 
 
 class ModuleValueAndGrad:
