@@ -129,3 +129,64 @@ class TestResNet:
         m = tt.jit(ResNet(cfg))
         x = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
         assert tuple(m(x).shape) == (1, 4)
+
+
+def test_batchnorm_running_stats_epilogue():
+    """Buffer mutations (BatchNorm running stats) are recorded as trace side
+    effects and replayed by the epilogue — through plain forward, chained
+    calls, eval mode, and the jitted TrainStep (reference epilogue trace,
+    thunder/core/jit_ext.py:2149)."""
+    import torch
+
+    from thunder_tpu import optim
+    from thunder_tpu.models.resnet import BatchNorm2d
+    from thunder_tpu.training import TrainStep
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(4, 3, 8, 8).astype(np.float32)
+
+    tbn = torch.nn.BatchNorm2d(3)
+    tbn.train()
+    t_out = tbn(torch.tensor(x_np))
+    ref_mean1 = tbn.running_mean.detach().numpy().copy()
+    ref_var1 = tbn.running_var.detach().numpy().copy()
+
+    bn = BatchNorm2d(3)
+    tm = tt.jit(bn)
+    out = tm(jnp.asarray(x_np))
+    np.testing.assert_allclose(np.asarray(out), t_out.detach().numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(bn._buffers["running_mean"]), ref_mean1, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(bn._buffers["running_var"]), ref_var1, atol=1e-4)
+
+    # second call consumes the UPDATED stats (buffers are inputs, not baked)
+    tm(jnp.asarray(x_np))
+    tbn(torch.tensor(x_np))
+    np.testing.assert_allclose(np.asarray(bn._buffers["running_mean"]),
+                               tbn.running_mean.detach().numpy(), atol=1e-5)
+
+    # eval mode normalizes with the running stats
+    bn.eval()
+    tbn.eval()
+    oe = tt.jit(bn)(jnp.asarray(x_np))
+    te = tbn(torch.tensor(x_np))
+    np.testing.assert_allclose(np.asarray(oe), te.detach().numpy(), atol=1e-4)
+
+    # TrainStep: stats update through the whole-step jit program
+    from thunder_tpu.ops import ltorch as lt
+
+    class BNNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.bn = BatchNorm2d(3)
+            self.fc = nn.Linear(3 * 8 * 8, 4, seed=0)
+
+        def forward(self, x, y):
+            h = self.bn(x)
+            h = lt.reshape(h, (x.shape[0], -1))
+            return lt.mse_loss(self.fc(h), y)
+
+    net = BNNet()
+    step = TrainStep(net, optim.SGD(lr=0.01))
+    step(jnp.asarray(x_np), jnp.zeros((4, 4), jnp.float32))
+    np.testing.assert_allclose(np.asarray(net.bn._buffers["running_mean"]),
+                               0.1 * x_np.mean(axis=(0, 2, 3)), atol=1e-5)

@@ -93,7 +93,13 @@ def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None =
         trc.args = tuple(p for p, m in zip(proxy_leaves, tensor_mask) if m)
         pargs, pkwargs = tree_unflatten(treedef, proxy_leaves)
         result = fn(*pargs, **pkwargs)
-        prims.python_return(result)
+        if trc.side_effects:
+            # recorded mutations ride as extra outputs; the epilogue replays
+            # them onto their owners after execution (reference epilogue
+            # trace, thunder/core/jit_ext.py:2149)
+            prims.python_return((result, tuple(p for _, _, p in trc.side_effects)))
+        else:
+            prims.python_return(result)
     return trc, treedef, tensor_mask, leaves
 
 
@@ -189,6 +195,7 @@ class ThunderCompiledFunction:
             treedef=treedef,
             tensor_mask=tensor_mask,
             key=key,
+            effect_keys=[(owner, name) for owner, name, _ in trc.side_effects],
         )
         self._cache[key] = entry
         return entry
@@ -207,7 +214,30 @@ class ThunderCompiledFunction:
             cs.cache_hits += 1
         tensor_leaves = [_unwrap(l) for l, m in zip(leaves, tensor_mask) if m]
         flat_inputs = entry.prologue_fn(*tensor_leaves)
-        return entry.computation_fn(*flat_inputs)
+        out = entry.computation_fn(*flat_inputs)
+        if entry.effect_keys:
+            out, effects = out
+            self._apply_effects(entry.effect_keys, effects)
+        return out
+
+    def _apply_effects(self, effect_keys, effects):
+        """Epilogue: replay recorded buffer mutations onto their owners.
+        Under an ambient jax trace the values are tracers — stash them for
+        the enclosing program to consume via consume_pending_effects()
+        (TrainStep does this for its vag); an enclosing program that does not
+        consume them loses the updates."""
+        import jax as _jax
+
+        if any(isinstance(e, _jax.core.Tracer) for e in effects):
+            self._pending_effects = (effect_keys, tuple(effects))
+            return
+        for (owner, name), value in zip(effect_keys, effects):
+            owner._buffers[name] = value
+
+    def consume_pending_effects(self):
+        out = getattr(self, "_pending_effects", None)
+        self._pending_effects = None
+        return out
 
     # -- introspection (reference thunder/__init__.py:944-1106) --
     @property

@@ -1,9 +1,9 @@
 """LitGPT pretraining benchmark harness.
 
 Counterpart of reference thunder/benchmarks/benchmark_litgpt.py:475-871:
-reports tokens/sec (per-chip and global), model TFLOP/s, average iter time,
-and peak memory. Distributed modes map to mesh axes instead of torchrun
-process groups.
+reports tokens/sec (per-chip and global), model TFLOP/s, MFU, average iter
+time, peak memory, and saved-for-backward size. Distributed modes map to
+mesh axes instead of torchrun process groups.
 
 Usage:
     python -m thunder_tpu.benchmarks.litgpt_bench --model_name tiny-llama2 \
@@ -34,16 +34,71 @@ def model_flops_per_token(cfg) -> float:
     return 6.0 * n_params
 
 
+def peak_tflops_per_chip() -> float:
+    """bf16 MXU peak for the local chip generation."""
+    table = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6": 918.0}
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197.0
+
+
+def step_memory_gb(step) -> float | None:
+    """Compiled-program memory estimate (args+temps+outputs-aliased)."""
+    try:
+        trainable, frozen = step._split_params()
+        tparams = {k: p.data for k, p in trainable.items()}
+        fparams = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        lowered = step._jitted.lower(tparams, fparams, step.opt_state,
+                                     step._last_args, step._last_kwargs)
+        ma = lowered.compile().memory_analysis()
+        tot = (getattr(ma, "argument_size_in_bytes", 0)
+               + getattr(ma, "temp_size_in_bytes", 0)
+               + getattr(ma, "output_size_in_bytes", 0)
+               - getattr(ma, "alias_size_in_bytes", 0))
+        return round(tot / 2**30, 3)
+    except Exception:
+        return None
+
+
+def saved_for_backward_mib(step) -> float | None:
+    """Size of the residual tensors crossing the fwd/bwd split (reference
+    benchmark_litgpt.py:867 saved-for-backward accounting)."""
+    try:
+        entry = next(iter(step._vag._cache.values()))
+        ret = entry.fwd_trc.bound_symbols[-1]
+        saved = ret.args[0][1]
+        total = 0
+        for p in saved:
+            if hasattr(p, "shape") and hasattr(p, "dtype"):
+                n = 1
+                for d in p.shape:
+                    n *= int(d)
+                total += n * p.dtype.bytes
+        return round(total / 2**20, 1)
+    except Exception:
+        return None
+
+
 def run(args) -> dict:
     import thunder_tpu as tt
     from thunder_tpu import optim
     from thunder_tpu.models.litgpt import Config, GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
-    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     cfg = Config.from_name(args.model_name, block_size=args.seq_len)
+    transforms = []
+    if args.autocast:
+        # fp32 master weights + bf16 compute (the standard mixed recipe)
+        from thunder_tpu.transforms.autocast import AutocastTransform
+
+        transforms.append(AutocastTransform())
+        dtype = jnp.float32
+    else:
+        dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     model = GPTForCausalLM(cfg, dtype=dtype)
-    tm = tt.jit(model)
+    tm = tt.jit(model, transforms=transforms)
 
     n_devices = 1
     if args.distributed_mode != "none":
@@ -69,22 +124,24 @@ def run(args) -> dict:
     idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
     tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
 
+    step._last_args, step._last_kwargs = (idx, tgt), {}
     t0 = time.perf_counter()
     loss = step(idx, tgt)
-    jax.block_until_ready(loss)
+    float(loss)
     compile_time = time.perf_counter() - t0
 
     for _ in range(args.warmup_iters):
-        step(idx, tgt)
+        float(step(idx, tgt))  # value read: the only reliable sync over axon
     t0 = time.perf_counter()
     for _ in range(args.max_iters):
         loss = step(idx, tgt)
-    jax.block_until_ready(loss)
+    float(loss)  # forces the chained steps
     dt = (time.perf_counter() - t0) / args.max_iters
 
     tokens_per_iter = B * args.seq_len
     tokens_per_sec = tokens_per_iter / dt
     flops = model_flops_per_token(cfg) * tokens_per_iter
+    tflops = flops / dt / 1e12
     result = {
         "model": args.model_name,
         "distributed_mode": args.distributed_mode,
@@ -92,7 +149,10 @@ def run(args) -> dict:
         "iter_time_ms": dt * 1e3,
         "tokens_per_sec_global": tokens_per_sec,
         "tokens_per_sec_per_chip": tokens_per_sec / n_devices,
-        "model_tflops": flops / dt / 1e12,
+        "model_tflops": tflops,
+        "mfu": tflops / (peak_tflops_per_chip() * n_devices),
+        "peak_memory_gb": step_memory_gb(step),
+        "saved_for_backward_mib": saved_for_backward_mib(step),
         "compile_time_s": compile_time,
         "final_loss": float(loss),
     }
@@ -110,6 +170,8 @@ def main():
     p.add_argument("--warmup_iters", type=int, default=3)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--autocast", action="store_true",
+                   help="fp32 master weights + bf16 compute via AutocastTransform")
     p.add_argument("--distributed_mode", default="none",
                    choices=["none", "ddp", "fsdp", "ddp_fsdp"])
     p.add_argument("--n_devices", type=int, default=0)

@@ -20,13 +20,19 @@ from thunder_tpu import nn, optim
 from thunder_tpu.ops import ltorch
 
 
+def _force(out):
+    # a value READ is the only reliable device sync over the axon tunnel
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf)
+
+
 def _timeit(fn, *args, iters=20, warmup=3) -> float:
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        _force(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _force(out)
     return (time.perf_counter() - t0) / iters
 
 
@@ -35,6 +41,18 @@ def _tensor(rng, shape, dtype=jnp.bfloat16):
 
 
 BENCHMARKS: dict[str, Callable] = {}
+
+# executor mode for the current run: 'fused' (XLA regions, default) or
+# 'opbyop' (per-prim jaxex dispatch) — the reference's per-executor benchmark
+# matrix (thunder/benchmarks/targets.py:190-1010 runs each target under
+# eager/torch.compile/thunder(+nvfuser...))
+_MODE = "fused"
+
+
+def _jit(fn, **kw):
+    if _MODE == "opbyop":
+        kw["disable_fusion"] = True
+    return tt.jit(fn, **kw)
 
 
 def register(name):
@@ -48,7 +66,7 @@ def register(name):
 @register("litgpt_gelu")
 def bench_gelu(rng):
     x = _tensor(rng, (16, 2048, 4096))
-    cf = tt.jit(lambda x: ltorch.gelu(x, approximate="tanh"))
+    cf = _jit(lambda x: ltorch.gelu(x, approximate="tanh"))
     return _timeit(cf, x)
 
 
@@ -56,7 +74,7 @@ def bench_gelu(rng):
 def bench_swiglu(rng):
     gate = _tensor(rng, (8, 2048, 11008))
     up = _tensor(rng, (8, 2048, 11008))
-    cf = tt.jit(lambda g, u: ltorch.silu(g) * u)
+    cf = _jit(lambda g, u: ltorch.silu(g) * u)
     return _timeit(cf, gate, up)
 
 
@@ -64,7 +82,7 @@ def bench_swiglu(rng):
 def bench_rmsnorm(rng):
     x = _tensor(rng, (16, 2048, 4096))
     w = jnp.ones((4096,), jnp.bfloat16)
-    cf = tt.jit(lambda x, w: ltorch.rms_norm(x, (4096,), w))
+    cf = _jit(lambda x, w: ltorch.rms_norm(x, (4096,), w))
     return _timeit(cf, x, w)
 
 
@@ -73,7 +91,7 @@ def bench_sdpa(rng):
     q = _tensor(rng, (8, 32, 2048, 128))
     k = _tensor(rng, (8, 32, 2048, 128))
     v = _tensor(rng, (8, 32, 2048, 128))
-    cf = tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
+    cf = _jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
     return _timeit(cf, q, k, v, iters=10)
 
 
@@ -83,7 +101,7 @@ def bench_mlp(rng):
 
     cfg = Config.from_name("Llama-2-7b-hf")
     mlp = LLaMAMLP(cfg, dtype=jnp.bfloat16)
-    tm = tt.jit(mlp)
+    tm = _jit(mlp)
     x = _tensor(rng, (4, 2048, cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
@@ -94,7 +112,7 @@ def bench_nanogpt_block(rng):
 
     cfg = NanoGPTConfig()
     blk = NanoBlock(cfg, dtype=jnp.bfloat16)
-    tm = tt.jit(blk)
+    tm = _jit(blk)
     x = _tensor(rng, (8, 1024, cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
@@ -104,7 +122,7 @@ def bench_gpt2_fwd(rng):
     from thunder_tpu.models.nanogpt import NanoGPT, configs
 
     model = NanoGPT(configs["gpt2"], dtype=jnp.bfloat16)
-    tm = tt.jit(model)
+    tm = _jit(model)
     idx = jnp.asarray(rng.randint(0, 50000, (4, 1024)), jnp.int32)
     return _timeit(tm, idx, iters=5)
 
@@ -115,11 +133,42 @@ def bench_llama_attn(rng):
 
     cfg = Config.from_name("Llama-2-7b-hf")
     attn = CausalSelfAttention(cfg, dtype=jnp.bfloat16)
-    tm = tt.jit(attn)
+    tm = _jit(attn)
     T = 2048
     x = _tensor(rng, (1, T, cfg.n_embd))
     cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
     return _timeit(tm, x, cos, sin, iters=10)
+
+
+@register("litgpt_qkv_rope")
+def bench_qkv_rope(rng):
+    """QKV projection + split + RoPE (reference targets.py litgpt qkv+rope)."""
+    from thunder_tpu.models.litgpt import Config, build_rope_cache, _apply_rope
+
+    cfg = Config.from_name("Llama-2-7b-hf")
+    T = 2048
+    w = _tensor(rng, ((cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size, cfg.n_embd))
+    x = _tensor(rng, (1, T, cfg.n_embd))
+    cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+
+    def qkv_rope(x, w, cos, sin):
+        B = x.shape[0]
+        nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+        qkv = ltorch.reshape(ltorch.linear(x, w), (B, T, ng, nh // ng + 2, hs))
+        q = ltorch.reshape(qkv[:, :, :, : nh // ng, :], (B, T, nh, hs))
+        q = ltorch.permute(q, (0, 2, 1, 3))
+        return _apply_rope(q, cos, sin, cfg.rope_n_elem)
+
+    cf = _jit(qkv_rope)
+    return _timeit(cf, x, w, cos, sin, iters=10)
+
+
+@register("fused_cross_entropy")
+def bench_cross_entropy(rng):
+    logits = _tensor(rng, (8192, 32000), jnp.float32)
+    tgt = jnp.asarray(rng.randint(0, 32000, (8192,)), jnp.int32)
+    cf = _jit(lambda l, t: ltorch.cross_entropy(l, t))
+    return _timeit(cf, logits, tgt, iters=10)
 
 
 @register("train_step_tiny_gpt")
@@ -144,7 +193,7 @@ def bench_resnet50(rng):
     from thunder_tpu.models.resnet import build
 
     model = build("resnet50", dtype=jnp.bfloat16)
-    tm = tt.jit(model)
+    tm = _jit(model)
     x = _tensor(rng, (8, 3, 224, 224))
     return _timeit(tm, x, iters=5)
 
@@ -155,7 +204,7 @@ def bench_moe_block(rng):
 
     cfg = MoEConfig(n_embd=1024, n_expert=8, n_expert_per_token=2)
     mlp = MoEMLP(cfg, dtype=jnp.bfloat16)
-    tm = tt.jit(mlp)
+    tm = _jit(mlp)
     x = _tensor(rng, (8, 512, cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
@@ -165,22 +214,48 @@ def bench_vit(rng):
     from thunder_tpu.models.vit import ViT, configs
 
     model = ViT(configs["vit-b16"], dtype=jnp.bfloat16)
-    tm = tt.jit(model)
+    tm = _jit(model)
     x = _tensor(rng, (8, 3, 224, 224))
     return _timeit(tm, x, iters=5)
 
 
-def main(pattern: str = ""):
+def main(pattern: str = "", modes=("fused", "opbyop")):
+    """Per-target x per-executor matrix with a winner column (reference
+    targets.py benchmark CI table)."""
+    global _MODE
     rng = np.random.RandomState(0)
+    rows = []
     for name, fn in BENCHMARKS.items():
         if pattern and pattern not in name:
             continue
-        try:
-            dt = fn(rng)
-            print(f"{name:28s} {dt*1e3:9.3f} ms/iter")
-        except Exception as e:
-            print(f"{name:28s} FAILED: {e}")
+        row = {"target": name}
+        for mode in modes:
+            _MODE = mode
+            try:
+                row[mode] = fn(rng) * 1e3
+            except Exception as e:
+                row[mode] = None
+                row.setdefault("errors", {})[mode] = str(e)[:80]
+        rows.append(row)
+    _MODE = "fused"
+    hdr = f"{'target':28s}" + "".join(f"{m:>12s}" for m in modes) + f"{'winner':>10s}"
+    print(hdr)
+    print("-" * len(hdr))
+    for row in rows:
+        cells = ""
+        best, best_t = "-", None
+        for m in modes:
+            v = row.get(m)
+            cells += f"{v:12.3f}" if v is not None else f"{'FAIL':>12s}"
+            if v is not None and (best_t is None or v < best_t):
+                best, best_t = m, v
+        print(f"{row['target']:28s}{cells}{best:>10s}")
+        for m, err in row.get("errors", {}).items():
+            print(f"    {m} error: {err}")
+    return rows
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "")
+    pat = sys.argv[1] if len(sys.argv) > 1 else ""
+    modes = tuple(sys.argv[2].split(",")) if len(sys.argv) > 2 else ("fused", "opbyop")
+    main(pat, modes)

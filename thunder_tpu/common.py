@@ -68,6 +68,7 @@ class CacheEntry:
         "tensor_mask",
         "static_leaves",
         "key",
+        "effect_keys",  # [(owner_module, buffer_name)] epilogue targets
     )
 
     def __init__(self, **kw):

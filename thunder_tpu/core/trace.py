@@ -57,6 +57,10 @@ class TraceCtx(baseutils.TraceInterface):
         self._any_call_ctx: dict = {}
         self.is_prologue = prologue
         self.tags: set = set()
+        # (owner, attr_name, proxy) mutations recorded during tracing, replayed
+        # by the epilogue after computation (reference epilogue trace,
+        # thunder/core/jit_ext.py:2149)
+        self.side_effects: list = []
 
     # ---- naming ----
     def make_name(self, prefix: str = "t") -> str:
@@ -161,6 +165,7 @@ def from_trace(trace: TraceCtx) -> TraceCtx:
     t._counters = dict(trace._counters)
     t._name = trace._name
     t.tags = set(trace.tags)
+    t.side_effects = list(trace.side_effects)
     return t
 
 

@@ -70,6 +70,12 @@ class TraceSubstitutionProcessor:
                 else:
                     out = replaced
                 self.map_out(bsym.output, out)
+        # side effects survive the rewrite, with proxies remapped through the
+        # substitution env (else effect metadata silently vanishes while the
+        # packed RETURN keeps referencing the values)
+        new_trace.side_effects = [
+            (owner, name, self.lookup(p)) for owner, name, p in getattr(self.trace, "side_effects", ())
+        ]
         return new_trace
 
 

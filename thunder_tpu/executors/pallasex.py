@@ -278,12 +278,16 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=
         return False
     if getattr(q, "ndim", 0) != 4 or getattr(k, "ndim", 0) != 4 or getattr(v, "ndim", 0) != 4:
         return False
+    # Short sequences: XLA's fused composite attention is faster on-chip than
+    # a pallas round-trip (measured on v5e: composite wins at T<=2048, flash
+    # wins >=2x at T=8192). But the composite materializes B*H*T*T scores —
+    # at T=2048 claim flash once that tensor is big enough to pressure HBM.
+    T = q.shape[-2]
+    score_bytes = q.shape[0] * q.shape[1] * T * T * 2
+    long_enough = T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
     shapes_ok = (
         q.shape[-1] <= 512  # any head dim (zero-padded to the 128 lane)
-        # short sequences: XLA's fused composite attention is faster on-chip
-        # than a pallas round-trip (measured on v5e: composite wins at T<=2048,
-        # flash wins >=2x at T=8192 where the T^2 score tensor dominates)
-        and q.shape[-2] >= 4096
+        and long_enough
         and q.shape[-2] % DEFAULT_BLOCK_Q == 0
         and k.shape[-2] % DEFAULT_BLOCK_K == 0
         and q.shape[-2] == k.shape[-2]

@@ -84,6 +84,13 @@ configs: dict[str, dict] = {
         name="nanogpt-124m", block_size=1024, vocab_size=50257, n_layer=12, n_head=12, n_embd=768,
         norm_class_name="LayerNorm", mlp_class_name="GptNeoxMLP", bias=True,
     ),
+    # largest Llama-2-class config that trains on ONE v5e chip (16 GB) with
+    # AdamW fp32 state — the single-chip north-star shape (BASELINE.json)
+    "llama-350m": dict(
+        name="llama-350m", block_size=2048, vocab_size=32000, padded_vocab_size=32000,
+        n_layer=24, n_head=16, n_embd=1024, intermediate_size=2816,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=10000,
+    ),
     "Llama-2-7b-hf": dict(
         name="Llama-2-7b-hf", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
         n_layer=32, n_head=32, n_embd=4096, intermediate_size=11008,

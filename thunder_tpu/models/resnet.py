@@ -5,9 +5,11 @@ Capability counterpart of the reference's ResNet50 benchmark target
 batch-norm / pooling prim family: convolutions lower to XLA conv (MXU),
 pooling to ReduceWindow (executors/jaxex.py REDUCE_WINDOW).
 
-BatchNorm here is the functional form: in training mode batch statistics are
-used in-graph and running stats are NOT updated in place (the framework is
-functional; a training loop that needs running stats carries them explicitly).
+BatchNorm carries running_mean/running_var buffers: training mode normalizes
+with batch statistics and records the running-stat update as a trace side
+effect which the epilogue replays onto the module after the step (reference
+epilogue trace, thunder/core/jit_ext.py:2149); eval mode normalizes with the
+running stats.
 """
 from __future__ import annotations
 
@@ -38,13 +40,33 @@ configs = {
 
 
 class BatchNorm2d(nn.Module):
-    def __init__(self, channels: int, dtype=jnp.float32):
+    def __init__(self, channels: int, dtype=jnp.float32, momentum: float = 0.1, eps: float = 1e-5):
         super().__init__()
         self.weight = nn.Parameter(jnp.ones((channels,), dtype))
         self.bias = nn.Parameter(jnp.zeros((channels,), dtype))
+        self.momentum = momentum
+        self.eps = eps
+        self.register_buffer("running_mean", jnp.zeros((channels,), dtype))
+        self.register_buffer("running_var", jnp.ones((channels,), dtype))
 
     def forward(self, x):
-        return ltorch.batch_norm(x, None, None, self.weight, self.bias, training=True)
+        if self.training:
+            dims = (0,) + tuple(range(2, x.ndim))
+            m = ltorch.mean(x, dims)
+            centered = x - ltorch.reshape(m, (1, m.shape[0]) + (1,) * (x.ndim - 2))
+            v = ltorch.mean(centered * centered, dims)
+            # unbiased variance for the running stat (torch semantics)
+            n = 1
+            for d in dims:
+                n *= x.shape[d]
+            unbiased = v * (n / max(1, n - 1))
+            mom = self.momentum
+            self.update_buffer("running_mean", (1 - mom) * self.running_mean + mom * m)
+            self.update_buffer("running_var", (1 - mom) * self.running_var + mom * unbiased)
+            return ltorch.batch_norm(x, None, None, self.weight, self.bias,
+                                     training=True, eps=self.eps)
+        return ltorch.batch_norm(x, self.running_mean, self.running_var,
+                                 self.weight, self.bias, training=False, eps=self.eps)
 
 
 class ConvBN(nn.Module):

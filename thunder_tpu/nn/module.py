@@ -76,6 +76,23 @@ class Module:
     def register_buffer(self, name: str, value) -> None:
         self._buffers[name] = value
 
+    def update_buffer(self, name: str, value) -> None:
+        """Write a buffer; inside a trace the write is recorded as a side
+        effect and replayed by the epilogue after computation (reference
+        epilogue trace, thunder/core/jit_ext.py:2149) — BatchNorm running
+        stats are the canonical use."""
+        from ..core.proxies import Proxy
+        from ..core.trace import get_tracectx
+
+        trc = get_tracectx()
+        if trc is not None and isinstance(value, Proxy):
+            trc.side_effects.append((self, name, value))
+            # also visible to later reads within this trace (weight sharing /
+            # repeated calls); functional_params' finally restores originals
+            self._buffers[name] = value
+            return
+        self._buffers[name] = value
+
     def register_parameter(self, name: str, value: Parameter) -> None:
         self._parameters[name] = value
 
@@ -171,21 +188,31 @@ class Module:
 
 @contextmanager
 def functional_params(module: Module, param_map: dict):
-    """Temporarily replace parameters (by qualified name) with given values —
-    the tracing-time analog of the reference's ThunderModule overrides
-    (thunder/core/module.py:30)."""
+    """Temporarily replace parameters AND buffers (by qualified name) with
+    given values — the tracing-time analog of the reference's ThunderModule
+    overrides (thunder/core/module.py:30). Buffers must be swapped too so
+    mutable state (running stats) enters the trace as an input, not a baked
+    constant."""
     saved = []
+    saved_buf = []
     for mod_name, mod in module.named_modules():
         for p_name in list(mod._parameters):
             q = f"{mod_name}.{p_name}" if mod_name else p_name
             if q in param_map:
                 saved.append((mod, p_name, mod._parameters[p_name]))
                 mod._parameters[p_name] = param_map[q]
+        for b_name in list(mod._buffers):
+            q = f"{mod_name}.{b_name}" if mod_name else b_name
+            if q in param_map:
+                saved_buf.append((mod, b_name, mod._buffers[b_name]))
+                mod._buffers[b_name] = param_map[q]
     try:
         yield
     finally:
         for mod, p_name, orig in saved:
             mod._parameters[p_name] = orig
+        for mod, b_name, orig in saved_buf:
+            mod._buffers[b_name] = orig
 
 
 class ThunderModule:
@@ -247,12 +274,17 @@ class ThunderModule:
         params.update(self._overrides)
         return params
 
+    def get_buffers(self) -> dict:
+        """Qualified-name buffers — traced as inputs so mutable state
+        (running stats) is not baked into the program as constants."""
+        return dict(self._module.named_buffers())
+
     def set_override(self, name: str, param: Parameter) -> None:
         """Install a parameter override (sharded/quantized replacement)."""
         self._overrides[name] = param
 
     def __call__(self, *args, **kwargs):
-        return self._cfn(self.get_parameters(), args, kwargs)
+        return self._cfn({**self.get_parameters(), **self.get_buffers()}, args, kwargs)
 
     def state_dict(self):
         return self._module.state_dict()

@@ -98,12 +98,16 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
             def raw_step(tparams, frozen, opt_state, args, kwargs):
                 loss, grads = vag(tparams, frozen, args, kwargs)
                 new_params, new_state = optimizer.update(tparams, grads[0][0], opt_state)
-                return loss, new_params, new_state
+                vag.consume_pending_effects()  # buffer mutations unsupported here
+                return loss, new_params, new_state, ()
 
             mesh = plan.mesh
-            all_params = self.tmodule.get_parameters()
+            all_params = dict(self.tmodule.get_parameters())
             trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
-            frozen = {k: p.data for k, p in all_params.items() if k not in trainable}
+            getb = getattr(self.tmodule, "get_buffers", None)
+            if callable(getb):
+                all_params.update(getb())
+            frozen = {k: getattr(p, "data", p) for k, p in all_params.items() if k not in trainable}
             pshard = {k: NamedSharding(mesh, plan.param_spec(k, v.ndim)) for k, v in trainable.items()}
             fshard = {k: NamedSharding(mesh, plan.param_spec(k, v.ndim)) for k, v in frozen.items()}
             # optimizer state follows its parameter's sharding where shapes match
@@ -118,7 +122,7 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
                 # pin outputs so updated params keep their declared layout
                 # (otherwise XLA may pick a different sharding and the next
                 # call's in_shardings mismatch)
-                out_shardings=(NamedSharding(mesh, P()), pshard, oshard),
+                out_shardings=(NamedSharding(mesh, P()), pshard, oshard, ()),
                 donate_argnums=(0, 2) if self.donate else (),
             )
 

@@ -93,23 +93,47 @@ class TrainStep:
         vag = self._make_vag(sync_loss=True)
         self._vag = vag
 
+        train_step = self
+
         def raw_step(tparam_arrays: dict, frozen_arrays: dict, opt_state, args, kwargs):
             loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
             param_grads = grads[0][0]
             new_params, new_state = optimizer.update(tparam_arrays, param_grads, opt_state)
-            return loss, new_params, new_state
+            pending = vag.consume_pending_effects()
+            if pending is not None:
+                # epilogue values (buffer mutations) ride out as jit outputs;
+                # __call__ replays them onto the module after the step
+                train_step._effect_keys = pending[0]
+                return loss, new_params, new_state, pending[1]
+            train_step._effect_keys = None
+            return loss, new_params, new_state, ()
 
         donate = (0, 2) if self.donate else ()
         if plan is None:
             self._jitted = jax.jit(raw_step, donate_argnums=donate)
         else:
-            self._jitted = _shard_mapped_step(raw_step, plan, self.tmodule, self.opt_state,
+            def raw_step_dist(*a, **kw):
+                out = raw_step(*a, **kw)
+                if out[3]:
+                    raise NotImplementedError(
+                        "buffer mutations (e.g. BatchNorm running stats) inside a "
+                        "distributed TrainStep are not supported yet — stats would "
+                        "need a cross-replica mean; freeze the buffers (module.eval()) "
+                        "or train without a mesh plan")
+                return out
+
+            self._jitted = _shard_mapped_step(raw_step_dist, plan, self.tmodule, self.opt_state,
                                               batch_args, batch_kwargs, donate)
 
     def _split_params(self):
         params = self.tmodule.get_parameters()
         trainable = {k: p for k, p in params.items() if getattr(p, "requires_grad", True)}
         frozen = {k: p for k, p in params.items() if k not in trainable}
+        # buffers (running stats etc.) ride as frozen inputs so they are not
+        # baked into the program as constants
+        getb = getattr(self.tmodule, "get_buffers", None)
+        if callable(getb):
+            frozen.update(getb())
         return trainable, frozen
 
     def __call__(self, *args, **kwargs):
@@ -117,7 +141,7 @@ class TrainStep:
             return self.micro_step(*args, **kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
         if self.opt_state is None:
             self.opt_state = self.optimizer.init(tparam_arrays)
         if self._jitted is None:
@@ -134,7 +158,12 @@ class TrainStep:
                     tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
             self._grad_acc = None
         else:
-            loss, new_params, self.opt_state = self._jitted(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            loss, new_params, self.opt_state, effects = self._jitted(
+                tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            if effects and getattr(self, "_effect_keys", None):
+                # epilogue: replay traced buffer mutations (running stats)
+                for (owner, name), v in zip(self._effect_keys, effects):
+                    owner._buffers[name] = v
         for k, p in trainable.items():
             p.data = new_params[k]
         self._step_count += 1
@@ -159,7 +188,7 @@ class TrainStep:
             return self._micro_step_dist(plan, args, kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)
@@ -169,6 +198,11 @@ class TrainStep:
 
             def micro(tparam_arrays, frozen_arrays, acc, args, kwargs):
                 loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
+                if vag.consume_pending_effects():
+                    raise NotImplementedError(
+                        "buffer mutations are not supported inside no_sync "
+                        "accumulation windows yet; freeze the buffers (eval()) "
+                        "or step without no_sync")
                 g = grads[0][0]
                 new_acc = g if acc is None else {k: acc[k] + g[k] for k in g}
                 return loss, new_acc
@@ -213,7 +247,7 @@ class TrainStep:
             return self._micro_step_fsdp(plan, args, kwargs)
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)
@@ -241,6 +275,10 @@ class TrainStep:
 
             def micro_raw(tparams, frozen_a, acc, a, kw):
                 loss_local, grads = vagn(tparams, frozen_a, a, kw)
+                if vagn.consume_pending_effects():
+                    raise NotImplementedError(
+                        "buffer mutations are not supported in distributed "
+                        "no_sync windows; freeze the buffers (eval())")
                 g = grads[0][0]
                 new_acc = {k: acc[k] + g[k][None] for k in g}
                 loss = jax.lax.psum(loss_local, axes) / ndev
@@ -303,7 +341,7 @@ class TrainStep:
     def _micro_step_fsdp(self, plan, args, kwargs):
         trainable, frozen = self._split_params()
         tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: p.data for k, p in frozen.items()}
+        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)
@@ -331,6 +369,10 @@ class TrainStep:
 
             def micro_raw(tfull, ffull, acc, a, kw):
                 loss_local, grads = vagf(tfull, ffull, a, kw)
+                if vagf.consume_pending_effects():
+                    raise NotImplementedError(
+                        "buffer mutations are not supported in FSDP no_sync "
+                        "windows; freeze the buffers (eval())")
                 g = grads[0][0]
                 new_acc = {k: acc[k] + g[k][None] for k in g}
                 loss = jax.lax.psum(loss_local, axes) / ndev
@@ -379,6 +421,7 @@ class TrainStep:
 
             def fold_raw(tshards, opt_st, tfull, ffull, acc, a, kw):
                 loss_local, grads = vagf(tfull, ffull, a, kw)
+                vagf.consume_pending_effects()
                 g = grads[0][0]
                 total = {k: g[k] + acc[k][0] for k in g}
                 gshards = {k: shard_grad(k, total[k], tshards[k]) for k in total}
@@ -417,6 +460,7 @@ class TrainStep:
 
             def fold_raw(tparams, frozen_a, opt_st, acc, a, kw):
                 loss_local, grads = vagn(tparams, frozen_a, a, kw)
+                vagn.consume_pending_effects()
                 g = grads[0][0]
                 total = {k: jax.lax.psum(g[k] + acc[k][0], axes) / ndev for k in g}
                 new_params, new_state = optimizer.update(tparams, total, opt_st)
@@ -439,6 +483,7 @@ class TrainStep:
 
             def step_acc(tparam_arrays, frozen_arrays, opt_state, acc, args, kwargs):
                 loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
+                vag.consume_pending_effects()  # window already rejected effects in micro
                 g = grads[0][0]
                 total = {k: g[k] + acc[k] for k in g}
                 new_params, new_state = optimizer.update(tparam_arrays, total, opt_state)
@@ -510,9 +555,12 @@ def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwa
     collectives and overlaps them with compute."""
     from jax.sharding import PartitionSpec as P
 
-    all_params = tmodule.get_parameters()
+    all_params = dict(tmodule.get_parameters())
     trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
-    frozen = {k: p.data for k, p in all_params.items() if k not in trainable}
+    getb = getattr(tmodule, "get_buffers", None)
+    if callable(getb):
+        all_params.update(getb())
+    frozen = {k: getattr(p, "data", p) for k, p in all_params.items() if k not in trainable}
     if opt_state is None:
         raise RuntimeError("opt_state must be initialized before building the distributed step")
     param_specs, frozen_specs, args_specs, kwargs_specs = _dist_in_specs(
@@ -520,5 +568,5 @@ def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwa
     opt_specs = _opt_state_specs(opt_state, param_specs)
     smapped = _shard_map_compat(raw_step, plan.mesh,
                                 (param_specs, frozen_specs, opt_specs, args_specs, kwargs_specs),
-                                (P(), param_specs, opt_specs))
+                                (P(), param_specs, opt_specs, ()))
     return jax.jit(smapped, donate_argnums=donate)

@@ -12,12 +12,21 @@ from ..core.proxies import TensorProxy
 from ..core.trace_interpreter import TraceSubstitutionProcessor
 from ..core.transform_common import Transform
 
-# ops computed in the autocast dtype (inputs cast down)
+# ops computed in the autocast dtype (inputs cast down) — both the prim ids
+# and the ltorch-level symbol ids (acquired traces record the latter at top
+# level; matching only prims silently left every linear in fp32)
 _LOW_PRECISION_IDS = {
     PrimIDs.MATMUL,
     PrimIDs.LINEAR,
     PrimIDs.CONVOLUTION,
     PrimIDs.GROUPED_MM,
+    "torch.matmul",
+    "torch.mm",
+    "torch.bmm",
+    "torch.einsum",
+    "torch.nn.functional.linear",
+    "torch.nn.functional.conv2d",
+    "torch.nn.functional.conv1d",
     "torch.nn.functional.scaled_dot_product_attention",
 }
 # composite ops forced to f32 compute (their decompositions stay f32)

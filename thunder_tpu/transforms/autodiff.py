@@ -902,6 +902,7 @@ class ForwardBackwardTraces(NamedTuple):
     backward_trace: TraceCtx
     n_saved: int
     grad_arg_names: tuple  # names of fwd-trace args receiving grads, in order
+    n_effects: int = 0  # trailing epilogue outputs in the fwd result tuple
 
 
 def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool = False) -> ForwardBackwardTraces:
@@ -924,6 +925,8 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
     diff: set[str] = set(grad_arg_names)
     tape: list[TapeEntry] = []
     fwd_output = None
+    has_effects = bool(getattr(trace, "side_effects", ()))
+    fwd_effects: tuple = ()
     # proxies produced while processing RECOMPUTE_IN_BACKWARD-tagged bsyms:
     # eligible to be re-derived in the backward instead of saved
     recompute_names: set[str] = set()
@@ -964,9 +967,16 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
                         recompute_names.add(o.name)
 
     def _process_inner(bsym: BoundSymbol, in_recompute: bool):
-        nonlocal fwd_output
+        nonlocal fwd_output, fwd_effects
         if bsym.sym.id == PrimIDs.RETURN:
-            fwd_output = lookup(bsym.args[0] if len(bsym.args) == 1 else bsym.args)
+            ret = bsym.args[0] if len(bsym.args) == 1 else bsym.args
+            if has_effects:
+                # acquire_trace packed (result, effect_values)
+                result_part, effects_part = ret
+                fwd_output = lookup(result_part)
+                fwd_effects = tuple(lookup(e) for e in effects_part)
+            else:
+                fwd_output = lookup(ret)
             return
         if bsym.sym.id in (PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL):
             return
@@ -1037,7 +1047,10 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
                     seen.add(r.name)
                     saved.append(r)
         saved, recompute_subgraph = _plan_recompute(fwd, saved, recompute_names)
-        prims.python_return((fwd_output, tuple(saved)))
+        if has_effects:
+            prims.python_return(((fwd_output, fwd_effects), tuple(saved)))
+        else:
+            prims.python_return((fwd_output, tuple(saved)))
 
     fwd_out_tensors = _flat_tensors(fwd_output)
 
@@ -1151,7 +1164,7 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
     bwd = dce(bwd)
     fwd.set_provenance("Augmented forward (autodiff)")
     bwd.set_provenance("Backward (autodiff)")
-    return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names)
+    return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names, len(fwd_effects))
 
 
 _fallback_sym_cache: dict = {}
@@ -1218,6 +1231,7 @@ class _VAGEntry(NamedTuple):
     grad_leaf_positions: tuple  # positions (within tensor leaves) receiving grads
     treedef: Any
     tensor_mask: tuple
+    effect_keys: tuple = ()  # (owner, name) epilogue targets
 
 
 class ThunderValueAndGrad:
@@ -1288,9 +1302,28 @@ class ThunderValueAndGrad:
 
         arg_name_to_pos = {p.name: i for i, p in enumerate(trc.args)}
         grad_positions = tuple(arg_name_to_pos[n] for n in fb.grad_arg_names)
-        entry = _VAGEntry(fwd_fn, bwd_fn, fwd_claimed, bwd_claimed, grad_positions, treedef, tuple(tensor_mask))
+        entry = _VAGEntry(fwd_fn, bwd_fn, fwd_claimed, bwd_claimed, grad_positions, treedef,
+                          tuple(tensor_mask),
+                          tuple((o, n) for o, n, _ in getattr(trc, "side_effects", ())))
         self._cache[key] = entry
         return entry
+
+    def _apply_effects(self, effect_keys, effects):
+        """Epilogue: replay buffer mutations. Under an ambient jax trace the
+        values are tracers — stash (keys, tracers) for the enclosing step
+        program (TrainStep plumbs them out as jit outputs)."""
+        import jax as _jax
+
+        if any(isinstance(e, _jax.core.Tracer) for e in effects):
+            self._pending_effects = (effect_keys, tuple(effects))
+            return
+        for (owner, name), value in zip(effect_keys, effects):
+            owner._buffers[name] = value
+
+    def consume_pending_effects(self):
+        out = getattr(self, "_pending_effects", None)
+        self._pending_effects = None
+        return out
 
     def __call__(self, *args, **kwargs):
         import jax
@@ -1318,6 +1351,9 @@ class ThunderValueAndGrad:
             entry = self._compile(args, kwargs, key)
         tensor_leaves = [_unwrap(l) for l, m in zip(leaves, tensor_mask) if m]
         out, saved = entry.fwd_fn(*tensor_leaves)
+        if entry.effect_keys:
+            out, effects = out
+            self._apply_effects(entry.effect_keys, effects)
         # cotangent: scalar loss -> 1.0
         cot = jnp.ones((), dtype=jnp.asarray(out).dtype) if hasattr(out, "dtype") else 1.0
         grads_flat = entry.bwd_fn(*saved, cot)
