@@ -47,9 +47,9 @@ def _flops_per_token(cfg, T: int) -> float:
     return model_flops_per_token(cfg) + 6.0 * cfg.n_layer * cfg.n_embd * T / 2.0 * 2.0
 
 
-def _mem_gb(jitted_or_none) -> float | None:
+def _mem_gb(step) -> float | None:
     try:
-        ma = jitted_or_none.memory_analysis()
+        ma = step.memory_analysis()
         tot = (getattr(ma, "argument_size_in_bytes", 0)
                + getattr(ma, "temp_size_in_bytes", 0)
                + getattr(ma, "output_size_in_bytes", 0)
@@ -103,20 +103,12 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     dt = time.perf_counter() - t0
     tps = (B * T * iters) / dt
 
-    compiled = None
-    try:  # peak memory from the compiled whole-step program
-        trainable, frozen = step._split_params()
-        tparams = {k: p.data for k, p in trainable.items()}
-        fparams = {k: getattr(p, "data", p) for k, p in frozen.items()}
-        compiled = step._jitted.lower(tparams, fparams, step.opt_state, (idx, tgt), {}).compile()
-    except Exception:
-        pass
     return {
         "tps": tps,
         "loss": loss_val,
         "flops_per_token": _flops_per_token(cfg, T),
         "peak_tflops": _peak_tflops(),
-        "mem_gb": _mem_gb(compiled),
+        "mem_gb": _mem_gb(step),
         "device_peak_gb": _device_peak_gb(),
     }
 

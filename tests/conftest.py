@@ -6,17 +6,23 @@ on the jax stack a virtual CPU mesh via --xla_force_host_platform_device_count
 covers multi-device semantics in-process."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may say "axon" (TPU tunnel)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# TT_ONCHIP=1 keeps the ambient TPU platform for the on-chip smoke tests
+# (tests/test_onchip.py); default is the virtual 8-device CPU mesh.
+_ONCHIP = os.environ.get("TT_ONCHIP") == "1"
+
+if not _ONCHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may say "axon" (TPU tunnel)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
 
-# The ambient environment pre-imports jax (sitecustomize on PYTHONPATH) with
-# JAX_PLATFORMS=axon, so the env vars above are read too late; force via config.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+if not _ONCHIP:
+    # The ambient environment pre-imports jax (sitecustomize on PYTHONPATH)
+    # with JAX_PLATFORMS=axon, so the env vars above are read too late.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

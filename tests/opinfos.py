@@ -632,8 +632,229 @@ def _ref_fold(a, out_size, ks):
     return out
 
 
+# ---------------------------------------------------------------------------
+# wave 3: auto-registered catalog ops (fft/linalg/special/blas composites/
+# activations) — exercises meta inference, claiming, fusion, and the generic
+# jax.vjp grad fallback for the long-tail surface
+# ---------------------------------------------------------------------------
+
+from thunder_tpu.ops.auto_register import get_auto_symbol
+
+
+def _a(name, ref, sample_gen, dts=F32, atol=1e-4, rtol=1e-4, supports_grad=True):
+    sym = get_auto_symbol(name)
+    assert sym is not None, f"auto op {name} missing"
+    return OpInfo(name=f"auto_{name}", op=sym, ref=ref, sample_generator=sample_gen,
+                  dtypes=dts, atol=atol, rtol=rtol, supports_grad=supports_grad)
+
+
+def _mat_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 4), dtype),))
+
+
+def _psd_samples(rng, dtype):
+    a = make_tensor(rng, (4, 4), dtype)
+    yield SampleInput((jnp.asarray(np.asarray(a) @ np.asarray(a).T + 4 * np.eye(4, dtype=np.asarray(a).dtype)),))
+
+
+def _two_mat_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 4), dtype), make_tensor(rng, (4, 4), dtype)))
+
+
+def _addmm_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3, 5), dtype), make_tensor(rng, (3, 4), dtype),
+                       make_tensor(rng, (4, 5), dtype)))
+
+
+def _baddbmm_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (2, 3, 5), dtype), make_tensor(rng, (2, 3, 4), dtype),
+                       make_tensor(rng, (2, 4, 5), dtype)))
+
+
+def _addmv_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3,), dtype), make_tensor(rng, (3, 4), dtype),
+                       make_tensor(rng, (4,), dtype)))
+
+
+def _vec_pair_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (5,), dtype), make_tensor(rng, (5,), dtype)))
+
+
+def _stack_list_samples(rng, dtype):
+    yield SampleInput(([make_tensor(rng, (3, 4), dtype), make_tensor(rng, (3, 4), dtype)],))
+
+
+def _tri_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 5), dtype),))
+    yield SampleInput((make_tensor(rng, (4, 5), dtype), 1))
+    yield SampleInput((make_tensor(rng, (4, 5), dtype), -1))
+
+
+def _moveaxis_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (2, 3, 4), dtype), 0, 2))
+
+
+def _diff_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3, 7), dtype),))
+
+
+def _quantile_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 9), dtype), 0.5))
+
+
+def _posneg_pair(rng, dtype):
+    yield SampleInput((make_tensor(rng, (6,), dtype), make_tensor(rng, (6,), dtype)))
+
+
+def _unit_interval_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3, 4), dtype, low=0.05, high=0.95),))
+
+
+def _sim_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 8), dtype), make_tensor(rng, (4, 8), dtype)))
+
+
+def _cdist_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (4, 3), dtype), make_tensor(rng, (5, 3), dtype)))
+
+
+def _prelu_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (2, 3, 4), dtype),
+                       make_tensor(rng, (3,), dtype, low=0.05, high=0.4)))
+
+
+def _fft_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (8,), dtype),))
+    yield SampleInput((make_tensor(rng, (3, 8), dtype),))
+
+
+def _int_pair_samples(rng, dtype):
+    yield SampleInput((jnp.asarray([4, 6, 9]), jnp.asarray([6, 4, 3])))
+
+
+def _ref_glu(a, dim=-1):
+    x, g = jnp.split(a, 2, axis=dim)
+    return x * jax.nn.sigmoid(g)
+
+
+def _glu_samples(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3, 8), dtype),))
+
+
+wave3_opinfos = [
+    # fft (complex outputs: forward-only; grads of complex not in scope)
+    _a("fft_rfft", jnp.fft.rfft, _fft_samples, supports_grad=False),
+    _a("fft_fftshift", jnp.fft.fftshift, _fft_samples, supports_grad=False),
+    # linalg
+    _a("linalg_inv", jnp.linalg.inv, _psd_samples, atol=1e-3, rtol=1e-3),
+    _a("linalg_det", jnp.linalg.det, _mat_samples, atol=1e-3, rtol=1e-3),
+    _a("linalg_solve", jnp.linalg.solve,
+       lambda rng, dt: iter([SampleInput((next(iter(_psd_samples(rng, dt))).args[0],
+                                          make_tensor(rng, (4, 2), dt)))]),
+       atol=1e-3, rtol=1e-3),
+    _a("linalg_cholesky", jnp.linalg.cholesky, _psd_samples, atol=1e-3, rtol=1e-3),
+    _a("linalg_matrix_power", lambda a, n: jnp.linalg.matrix_power(a, n),
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 4), dt), 3))]),
+       atol=1e-3, rtol=1e-3, supports_grad=False),
+    _a("matrix_exp", jax.scipy.linalg.expm, _mat_samples, atol=1e-3, rtol=1e-3, supports_grad=False),
+    _a("trace", jnp.trace, _mat_samples),
+    _a("kron", jnp.kron, _two_mat_samples) if get_auto_symbol("kron") else None,
+    # blas composites
+    _a("addmm", lambda i, a, b: i + a @ b, _addmm_samples),
+    _a("baddbmm", lambda i, a, b: i + a @ b, _baddbmm_samples),
+    _a("addmv", lambda i, m, v: i + m @ v, _addmv_samples),
+    _a("addr", lambda i, u, v: i + jnp.outer(u, v),
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3,), dt),
+                                          make_tensor(rng, (4,), dt)))])),
+    _a("bmm", lambda a, b: a @ b,
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt), make_tensor(rng, (2, 4, 5), dt)))])),
+    _a("ger", jnp.outer, _vec_pair_samples),
+    _a("inner", jnp.inner, _vec_pair_samples),
+    # special
+    _a("special_i0", jax.scipy.special.i0, _mat_samples, atol=1e-3),
+    _a("special_ndtr", jax.scipy.special.ndtr, _mat_samples),
+    _a("special_entr", jax.scipy.special.entr, _unit_interval_samples),
+    _a("special_expit", lambda a: 1 / (1 + jnp.exp(-a)), _mat_samples),
+    _a("special_xlogy", jax.scipy.special.xlogy, _posneg_pair, supports_grad=False),
+    _a("special_erfcx", lambda a: np.exp(np.asarray(a, np.float64) ** 2) *
+       (1 - np.vectorize(__import__("math").erf)(np.asarray(a, np.float64))),
+       _unit_interval_samples, atol=1e-3, supports_grad=False),
+    # stacking / reshaping
+    _a("dstack", jnp.dstack, _stack_list_samples, supports_grad=False),
+    _a("hstack", jnp.hstack, _stack_list_samples, supports_grad=False),
+    _a("vstack", jnp.vstack, _stack_list_samples, supports_grad=False),
+    _a("column_stack", jnp.column_stack, _stack_list_samples, supports_grad=False),
+    _a("atleast_2d", jnp.atleast_2d, lambda rng, dt: iter([SampleInput((make_tensor(rng, (5,), dt),))])),
+    _a("moveaxis", jnp.moveaxis, _moveaxis_samples),
+    _a("swapdims", jnp.swapaxes, _moveaxis_samples),
+    _a("tril", jnp.tril, _tri_samples),
+    _a("triu", jnp.triu, _tri_samples),
+    _a("diagflat", jnp.diagflat, lambda rng, dt: iter([SampleInput((make_tensor(rng, (4,), dt),))])),
+    _a("diagonal", lambda a, offset=0, dim1=0, dim2=1: jnp.diagonal(a, offset, dim1, dim2),
+       _mat_samples),
+    _a("diag_embed", lambda a: jax.vmap(jnp.diag)(a),
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))])),
+    _a("flipud", jnp.flipud, _mat_samples),
+    _a("fliplr", jnp.fliplr, _mat_samples),
+    _a("rot90", jnp.rot90, _mat_samples, supports_grad=False),
+    # numeric long tail
+    _a("quantile", lambda a, q: jnp.quantile(a, q), _quantile_samples, supports_grad=False),
+    _a("diff", jnp.diff, _diff_samples),
+    _a("trapezoid", jnp.trapezoid, _diff_samples),
+    _a("gcd", jnp.gcd, _int_pair_samples, dts=INTS[:1], supports_grad=False),
+    _a("lcm", jnp.lcm, _int_pair_samples, dts=INTS[:1], supports_grad=False),
+    _a("nextafter", jnp.nextafter, _posneg_pair, supports_grad=False),
+    _a("deg2rad", jnp.deg2rad, _mat_samples),
+    _a("rad2deg", jnp.rad2deg, _mat_samples),
+    _a("fmax", jnp.fmax, _posneg_pair),
+    _a("fmin", jnp.fmin, _posneg_pair),
+    _a("float_power", jnp.float_power,
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (4,), dt, low=0.2, high=2.0),
+                                          make_tensor(rng, (4,), dt, low=0.2, high=2.0)))]),
+       supports_grad=False),
+    _a("logit", lambda a: jnp.log(a / (1 - a)), _unit_interval_samples, atol=1e-3),
+    _a("cosine_similarity", lambda a, b: jnp.sum(a * b, 1) /
+       (jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1)),
+       _sim_samples, atol=1e-4),
+    _a("cdist", lambda a, b: jnp.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1) + 1e-30),
+       _cdist_samples, atol=1e-3),
+    _a("lerp", lambda a, b, w: a + w * (b - a),
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (4,), dt), make_tensor(rng, (4,), dt), 0.3))])),
+    _a("addcmul", lambda a, b, c: a + b * c,
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (4,), dt), make_tensor(rng, (4,), dt),
+                                          make_tensor(rng, (4,), dt)))])),
+    # activations
+    _a("elu", lambda a: jnp.where(a > 0, a, jnp.expm1(a)), _mat_samples),
+    _a("selu", jax.nn.selu, _mat_samples),
+    _a("celu", jax.nn.celu, _mat_samples),
+    _a("glu", _ref_glu, _glu_samples),
+    _a("hardswish", jax.nn.hard_swish, _mat_samples),
+    _a("hardsigmoid", jax.nn.hard_sigmoid, _mat_samples),
+    _a("hardtanh", lambda a: jnp.clip(a, -1, 1), _mat_samples),
+    _a("softsign", jax.nn.soft_sign, _mat_samples),
+    _a("tanhshrink", lambda a: a - jnp.tanh(a), _mat_samples),
+    _a("hardshrink", lambda a: jnp.where(jnp.abs(a) > 0.5, a, 0.0), _mat_samples,
+       supports_grad=False),
+    _a("softshrink", lambda a: jnp.where(a > 0.5, a - 0.5, jnp.where(a < -0.5, a + 0.5, 0.0)),
+       _mat_samples, supports_grad=False),
+    _a("threshold", lambda a, t, v: jnp.where(a > t, a, v),
+       lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt), 0.1, 0.0))]),
+       supports_grad=False),
+    _a("logsigmoid", jax.nn.log_sigmoid, _mat_samples),
+    _a("mish", lambda a: a * jnp.tanh(jnp.log1p(jnp.exp(a))), _mat_samples, atol=1e-3),
+    _a("softplus", lambda a: jnp.log1p(jnp.exp(a)), _mat_samples, atol=1e-3),
+    _a("prelu", lambda a, w: jnp.where(a >= 0, a, w.reshape(1, -1, 1) * a), _prelu_samples),
+    # complex support (forward only)
+    _a("real", jnp.real, _mat_samples, supports_grad=False),
+    _a("angle", jnp.angle, _mat_samples, supports_grad=False),
+    _a("view_as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1),
+       _mat_samples, supports_grad=False),
+]
+wave3_opinfos = [oi for oi in wave3_opinfos if oi is not None]
+
+
 all_opinfos = (unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos
-               + nn_opinfos + widened_opinfos + wave2_opinfos)
+               + nn_opinfos + widened_opinfos + wave2_opinfos + wave3_opinfos)
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]
 
 

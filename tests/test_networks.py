@@ -190,3 +190,22 @@ def test_batchnorm_running_stats_epilogue():
     step(jnp.asarray(x_np), jnp.zeros((4, 4), jnp.float32))
     np.testing.assert_allclose(np.asarray(net.bn._buffers["running_mean"]),
                                0.1 * x_np.mean(axis=(0, 2, 3)), atol=1e-5)
+
+
+def test_train_eval_mode_participates_in_cache_key(rng):
+    """eval() after a train-mode trace must retrace, not hit the stale cached
+    training program (which would keep mutating running stats)."""
+    from thunder_tpu.models.resnet import BatchNorm2d
+
+    x = jnp.asarray(rng.randn(4, 3, 8, 8).astype(np.float32))
+    bn = BatchNorm2d(3)
+    tm = tt.jit(bn)
+    tm(x)  # train-mode trace + stats update
+    m_after_train = np.asarray(bn._buffers["running_mean"]).copy()
+    bn.eval()
+    out_eval = tm(x)  # must retrace in eval mode
+    np.testing.assert_array_equal(np.asarray(bn._buffers["running_mean"]), m_after_train)
+    # eval output normalizes with running stats, not batch stats
+    expected = (np.asarray(x) - m_after_train.reshape(1, 3, 1, 1)) / np.sqrt(
+        np.asarray(bn._buffers["running_var"]).reshape(1, 3, 1, 1) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out_eval), expected, atol=1e-4)

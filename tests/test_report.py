@@ -53,3 +53,63 @@ def test_to_dot(rng):
     trc = tt.last_traces(cf)[0]
     dot = to_dot(trc)
     assert dot.startswith("digraph") and "->" in dot
+
+
+class TestExamineCoverage:
+    """VERDICT round-1 done-criterion: examine() reports zero unsupported ops
+    across the repo's model zoo and an HF-style transformer block."""
+
+    def _check(self, fn, *args, **kwargs):
+        from thunder_tpu.utils.examine import examine
+
+        report = examine(fn, *args, **kwargs)
+        assert report["supported"], report["unclaimed"]
+
+    def test_litgpt_llama(self, rng):
+        from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+
+        cfg = Config.from_name("tiny-llama2")
+        m = GPTForCausalLM(cfg)
+        idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 64)))
+        self._check(m, idx, idx)
+
+    def test_nanogpt(self, rng):
+        from thunder_tpu.models.nanogpt import NanoGPT, NanoGPTConfig
+
+        m = NanoGPT(NanoGPTConfig(n_layer=1, n_head=2, n_embd=32, block_size=32, vocab_size=128))
+        idx = jnp.asarray(rng.randint(0, 128, (2, 32)))
+        self._check(m, idx)
+
+    def test_resnet(self, rng):
+        from thunder_tpu.models.resnet import build
+
+        m = build("test")
+        x = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+        self._check(m, x)
+
+    def test_moe(self, rng):
+        from thunder_tpu.models.moe import MoEConfig, MoEMLP
+
+        m = MoEMLP(MoEConfig(n_embd=32, n_expert=4, n_expert_per_token=2))
+        x = jnp.asarray(rng.randn(2, 16, 32).astype(np.float32))
+        self._check(m, x)
+
+    def test_vit(self, rng):
+        from thunder_tpu.models.vit import ViT, ViTConfig
+
+        m = ViT(ViTConfig(image_size=32, patch_size=8, depth=1, heads=2,
+                          dim=32, mlp_dim=64, num_classes=10))
+        x = jnp.asarray(rng.randn(2, 3, 32, 32).astype(np.float32))
+        self._check(m, x)
+
+    def test_hf_style_gqa_block(self, rng):
+        """HF-llama-style GQA attention block (native op language): zero
+        unsupported ops (the torch-frontend HF path is covered by
+        test_torch_frontend.test_hf_llama_gqa_matches_eager)."""
+        from thunder_tpu.models.litgpt import Block, Config, build_rope_cache
+
+        cfg = Config.from_name("tiny-llama2")  # GQA: n_query_groups < n_head
+        blk = Block(cfg)
+        cos, sin = build_rope_cache(32, cfg.rope_n_elem, cfg.rope_base)
+        x = jnp.asarray(rng.randn(2, 32, cfg.n_embd).astype(np.float32))
+        self._check(blk, x, cos, sin)

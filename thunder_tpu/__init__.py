@@ -206,6 +206,11 @@ class ThunderCompiledFunction:
         leaves, _ = tree_flatten((args, kwargs))
         tensor_mask = [_is_tensor_like(l) for l in leaves]
         key = _cache_key(leaves, tensor_mask)
+        extra = getattr(self._cd.fn, "__cache_extra__", None)
+        if extra is not None:
+            # e.g. module train/eval mode: changes the traced program without
+            # changing any input metadata
+            key = key + (extra(),)
         entry = self._cache.get(key)
         if entry is None:
             cs.cache_misses += 1
@@ -220,24 +225,10 @@ class ThunderCompiledFunction:
             self._apply_effects(entry.effect_keys, effects)
         return out
 
-    def _apply_effects(self, effect_keys, effects):
-        """Epilogue: replay recorded buffer mutations onto their owners.
-        Under an ambient jax trace the values are tracers — stash them for
-        the enclosing program to consume via consume_pending_effects()
-        (TrainStep does this for its vag); an enclosing program that does not
-        consume them loses the updates."""
-        import jax as _jax
+    from .common import EpilogueMixin as _EM
 
-        if any(isinstance(e, _jax.core.Tracer) for e in effects):
-            self._pending_effects = (effect_keys, tuple(effects))
-            return
-        for (owner, name), value in zip(effect_keys, effects):
-            owner._buffers[name] = value
-
-    def consume_pending_effects(self):
-        out = getattr(self, "_pending_effects", None)
-        self._pending_effects = None
-        return out
+    _apply_effects = _EM.apply_effects
+    consume_pending_effects = _EM.consume_pending_effects
 
     # -- introspection (reference thunder/__init__.py:944-1106) --
     @property

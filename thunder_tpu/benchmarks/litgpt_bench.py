@@ -47,12 +47,7 @@ def peak_tflops_per_chip() -> float:
 def step_memory_gb(step) -> float | None:
     """Compiled-program memory estimate (args+temps+outputs-aliased)."""
     try:
-        trainable, frozen = step._split_params()
-        tparams = {k: p.data for k, p in trainable.items()}
-        fparams = {k: getattr(p, "data", p) for k, p in frozen.items()}
-        lowered = step._jitted.lower(tparams, fparams, step.opt_state,
-                                     step._last_args, step._last_kwargs)
-        ma = lowered.compile().memory_analysis()
+        ma = step.memory_analysis()
         tot = (getattr(ma, "argument_size_in_bytes", 0)
                + getattr(ma, "temp_size_in_bytes", 0)
                + getattr(ma, "output_size_in_bytes", 0)
@@ -124,7 +119,6 @@ def run(args) -> dict:
     idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
     tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, args.seq_len)), jnp.int32)
 
-    step._last_args, step._last_kwargs = (idx, tgt), {}
     t0 = time.perf_counter()
     loss = step(idx, tgt)
     float(loss)

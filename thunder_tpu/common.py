@@ -54,6 +54,28 @@ class CompileData:
         return self.compile_options.get(name, default)
 
 
+class EpilogueMixin:
+    """Shared epilogue: replay recorded buffer mutations onto their owners.
+    Under an ambient jax trace the values are tracers — they are stashed for
+    the enclosing program to consume via consume_pending_effects() (TrainStep
+    does this for its vag); an enclosing program that does not consume them
+    loses the updates."""
+
+    def apply_effects(self, effect_keys, effects):
+        import jax as _jax
+
+        if any(isinstance(e, _jax.core.Tracer) for e in effects):
+            self._pending_effects = (effect_keys, tuple(effects))
+            return
+        for (owner, name), value in zip(effect_keys, effects):
+            owner._buffers[name] = value
+
+    def consume_pending_effects(self):
+        out = getattr(self, "_pending_effects", None)
+        self._pending_effects = None
+        return out
+
+
 class CacheEntry:
     """One compiled specialization (reference thunder/__init__.py:258)."""
 

@@ -238,6 +238,11 @@ class ThunderModule:
                 return module(*args, **kwargs)
 
         _traced.__name__ = f"{type(module).__name__}_forward"
+        # train/eval mode changes the traced program (BatchNorm/Dropout
+        # branches) without changing input metadata — participate in the
+        # cache key so mode flips retrace instead of hitting a stale entry
+        _traced.__cache_extra__ = lambda: tuple(
+            m.training for m in module.modules())
 
         transforms = list(transforms or ())
         for tf in transforms:

@@ -98,7 +98,11 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
             def raw_step(tparams, frozen, opt_state, args, kwargs):
                 loss, grads = vag(tparams, frozen, args, kwargs)
                 new_params, new_state = optimizer.update(tparams, grads[0][0], opt_state)
-                vag.consume_pending_effects()  # buffer mutations unsupported here
+                if vag.consume_pending_effects():
+                    raise NotImplementedError(
+                        "buffer mutations (BatchNorm running stats) are not "
+                        "supported under gspmd_step yet; freeze the buffers "
+                        "(module.eval()) or use the explicit-collectives path")
                 return loss, new_params, new_state, ()
 
             mesh = plan.mesh

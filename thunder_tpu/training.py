@@ -146,6 +146,7 @@ class TrainStep:
             self.opt_state = self.optimizer.init(tparam_arrays)
         if self._jitted is None:
             self._build(args, kwargs)
+        self.last_batch = (args, kwargs)  # for memory_analysis/harnesses
         if self._grad_acc is not None:
             # final (syncing) step of a no_sync accumulation window: fold the
             # accumulated local grads in before the optimizer update
@@ -495,6 +496,16 @@ class TrainStep:
     @property
     def compile_stats(self):
         return getattr(self, "_vag", None) and self._vag._cs
+
+    def memory_analysis(self):
+        """Compiled-program memory analysis of the last-built step."""
+        if self._jitted is None or getattr(self, "last_batch", None) is None:
+            return None
+        trainable, frozen = self._split_params()
+        tparams = {k: p.data for k, p in trainable.items()}
+        fparams = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        args, kwargs = self.last_batch
+        return self._jitted.lower(tparams, fparams, self.opt_state, args, kwargs).compile().memory_analysis()
 
 
 def _batch_pspec(plan, leaf):

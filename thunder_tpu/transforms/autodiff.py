@@ -902,7 +902,7 @@ class ForwardBackwardTraces(NamedTuple):
     backward_trace: TraceCtx
     n_saved: int
     grad_arg_names: tuple  # names of fwd-trace args receiving grads, in order
-    n_effects: int = 0  # trailing epilogue outputs in the fwd result tuple
+
 
 
 def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool = False) -> ForwardBackwardTraces:
@@ -1164,7 +1164,7 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
     bwd = dce(bwd)
     fwd.set_provenance("Augmented forward (autodiff)")
     bwd.set_provenance("Backward (autodiff)")
-    return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names, len(fwd_effects))
+    return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names)
 
 
 _fallback_sym_cache: dict = {}
@@ -1308,22 +1308,10 @@ class ThunderValueAndGrad:
         self._cache[key] = entry
         return entry
 
-    def _apply_effects(self, effect_keys, effects):
-        """Epilogue: replay buffer mutations. Under an ambient jax trace the
-        values are tracers — stash (keys, tracers) for the enclosing step
-        program (TrainStep plumbs them out as jit outputs)."""
-        import jax as _jax
+    from ..common import EpilogueMixin as _EM
 
-        if any(isinstance(e, _jax.core.Tracer) for e in effects):
-            self._pending_effects = (effect_keys, tuple(effects))
-            return
-        for (owner, name), value in zip(effect_keys, effects):
-            owner._buffers[name] = value
-
-    def consume_pending_effects(self):
-        out = getattr(self, "_pending_effects", None)
-        self._pending_effects = None
-        return out
+    _apply_effects = _EM.apply_effects
+    consume_pending_effects = _EM.consume_pending_effects
 
     def __call__(self, *args, **kwargs):
         import jax

@@ -19,8 +19,18 @@ def examine(fn: Callable, *args, **kwargs) -> dict:
     """Trace fn and report op coverage: which symbols were recorded, which
     executors claim them, and any unclaimed ops."""
     from .. import acquire_trace
+    from ..nn.module import Module, ThunderModule
 
-    trc, _, _, _ = acquire_trace(fn, args, kwargs)
+    if isinstance(fn, Module):
+        from .. import jit as _jit
+
+        fn = _jit(fn)
+    if isinstance(fn, ThunderModule):
+        tm = fn
+        state = {**tm.get_parameters(), **tm.get_buffers()}
+        trc, _, _, _ = acquire_trace(tm._cfn._cd.fn, (state, args, kwargs), {})
+    else:
+        trc, _, _, _ = acquire_trace(fn, args, kwargs)
     executors = list(get_default_executors()) + list(get_always_executors())
 
     used: dict[str, int] = {}
@@ -39,7 +49,12 @@ def examine(fn: Callable, *args, **kwargs) -> dict:
                 for sub in bsym.subsymbols:
                     visit(sub)
             else:
-                unclaimed.append(key)
+                # pure pass-through (e.g. full-range getitem): outputs are
+                # existing proxies, nothing executes (passes.py same rule)
+                out_names = {o.name for o in bsym.flat_proxy_outs()}
+                in_names = {a.name for a in bsym.flat_proxy_args()}
+                if not (out_names <= in_names):
+                    unclaimed.append(key)
 
     for bsym in trc.bound_symbols:
         visit(bsym)
