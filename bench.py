@@ -50,6 +50,8 @@ def _flops_per_token(cfg, T: int) -> float:
 def _mem_gb(step) -> float | None:
     try:
         ma = step.memory_analysis()
+        if ma is None:
+            return None
         tot = (getattr(ma, "argument_size_in_bytes", 0)
                + getattr(ma, "temp_size_in_bytes", 0)
                + getattr(ma, "output_size_in_bytes", 0)

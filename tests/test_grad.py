@@ -148,3 +148,28 @@ def test_cross_entropy_grad_rule_matches_jax(rng, reduction, label_smoothing):
     rv, rg = jax.value_and_grad(ref)(logits)
     np.testing.assert_allclose(float(lv), float(rv), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(grads[0][0]), np.asarray(rg), atol=1e-5)
+
+
+def test_vag_retraces_on_train_eval_flip(rng):
+    """value_and_grad over a mode-dependent module must retrace when the
+    module flips train/eval (cache key includes __cache_extra__)."""
+    from thunder_tpu.models.resnet import BatchNorm2d
+
+    bn = BatchNorm2d(3)
+
+    class Probe(tt.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.bn = bn
+
+        def forward(self, x):
+            return tt.ops.ltorch.sum(self.bn(x))
+
+    vag = tt.value_and_grad(Probe())
+    x = jnp.asarray(rng.randn(4, 3, 4, 4).astype(np.float32))
+    vag(x)
+    m_train = np.asarray(bn._buffers["running_mean"]).copy()
+    assert not np.allclose(m_train, 0.0)
+    bn.eval()
+    vag(x)  # must NOT hit the train-mode entry (which would mutate stats)
+    np.testing.assert_array_equal(np.asarray(bn._buffers["running_mean"]), m_train)

@@ -26,7 +26,7 @@ from .core.proxies import NumberProxy, Proxy, TensorProxy, proxy_from_jax
 from .core.pytree import tree_flatten, tree_unflatten
 from .core.trace import TraceCtx, tracectx
 from .core.transform_common import Transform, cse, dce
-from .common import CacheEntry, CompileData, CompileStats
+from .common import CacheEntry, CompileData, CompileStats, EpilogueMixin
 from .extend import (
     Executor,
     FusionExecutor,
@@ -137,7 +137,7 @@ def _cache_key(leaves, tensor_mask) -> tuple:
     return tuple(key)
 
 
-class ThunderCompiledFunction:
+class ThunderCompiledFunction(EpilogueMixin):
     """The callable returned by jit() (reference thunder/__init__.py:881 fn_)."""
 
     def __init__(self, cd: CompileData):
@@ -222,13 +222,10 @@ class ThunderCompiledFunction:
         out = entry.computation_fn(*flat_inputs)
         if entry.effect_keys:
             out, effects = out
-            self._apply_effects(entry.effect_keys, effects)
+            self.apply_effects(entry.effect_keys, effects)
         return out
 
-    from .common import EpilogueMixin as _EM
 
-    _apply_effects = _EM.apply_effects
-    consume_pending_effects = _EM.consume_pending_effects
 
     # -- introspection (reference thunder/__init__.py:944-1106) --
     @property

@@ -48,6 +48,8 @@ def step_memory_gb(step) -> float | None:
     """Compiled-program memory estimate (args+temps+outputs-aliased)."""
     try:
         ma = step.memory_analysis()
+        if ma is None:
+            return None
         tot = (getattr(ma, "argument_size_in_bytes", 0)
                + getattr(ma, "temp_size_in_bytes", 0)
                + getattr(ma, "output_size_in_bytes", 0)
@@ -82,7 +84,8 @@ def run(args) -> dict:
     from thunder_tpu.models.litgpt import Config, GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
-    cfg = Config.from_name(args.model_name, block_size=args.seq_len)
+    cfg = Config.from_name(args.model_name, block_size=args.seq_len,
+                           activation_checkpoint=args.activation_checkpoint)
     transforms = []
     if args.autocast:
         # fp32 master weights + bf16 compute (the standard mixed recipe)
@@ -164,6 +167,8 @@ def main():
     p.add_argument("--warmup_iters", type=int, default=3)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--activation_checkpoint", action="store_true",
+                   help="recompute each block in backward (remat.checkpoint)")
     p.add_argument("--autocast", action="store_true",
                    help="fp32 master weights + bf16 compute via AutocastTransform")
     p.add_argument("--distributed_mode", default="none",

@@ -64,11 +64,17 @@ class TraceSubstitutionProcessor:
                     continue
                 margs = self.lookup(bsym.args)
                 mkwargs = self.lookup(bsym.kwargs)
+                scope_start = len(new_trace.bound_symbols)
                 replaced = self.visitor(bsym, margs, mkwargs)
                 if replaced is None:
                     out = bsym.sym(*margs, **mkwargs)
                 else:
                     out = replaced
+                if bsym.tags:
+                    # tags (e.g. RECOMPUTE_IN_BACKWARD) survive the rewrite —
+                    # losing them silently disables activation checkpointing
+                    for nb in new_trace.bound_symbols[scope_start:]:
+                        nb.tags |= bsym.tags
                 self.map_out(bsym.output, out)
         # side effects survive the rewrite, with proxies remapped through the
         # substitution env (else effect metadata silently vanishes while the

@@ -43,6 +43,9 @@ class Config:
     rope_base: int = 10000
     lm_head_bias: bool = False
     shared_embedding: bool = False
+    # recompute each transformer block in the backward instead of saving its
+    # activations (remat.checkpoint -> RECOMPUTE_IN_BACKWARD machinery)
+    activation_checkpoint: bool = False
 
     def __post_init__(self):
         if self.padded_vocab_size is None:
@@ -232,11 +235,16 @@ class GPT(nn.Module):
         self.register_buffer("sin", sin)
 
     def forward(self, idx):
+        from ..transforms import remat
+
         B, T = idx.shape
         cos, sin = rope_slice(self.cos, self.sin, T)
         x = self.wte(idx)
         for block in self.h:
-            x = block(x, cos, sin)
+            if self.cfg.activation_checkpoint:
+                x = remat.checkpoint(block)(x, cos, sin)
+            else:
+                x = block(x, cos, sin)
         x = self.ln_f(x)
         return self.lm_head(x)
 

@@ -25,6 +25,7 @@ from ..core.proxies import NumberProxy, Proxy, TensorProxy, variableify
 from ..core.symbol import BoundSymbol, OpTags, Symbol
 from ..core.trace import TraceCtx, from_trace, tracectx
 from ..core.transform_common import dce
+from ..common import EpilogueMixin
 from ..ops import clang
 
 
@@ -1234,7 +1235,7 @@ class _VAGEntry(NamedTuple):
     effect_keys: tuple = ()  # (owner, name) epilogue targets
 
 
-class ThunderValueAndGrad:
+class ThunderValueAndGrad(EpilogueMixin):
     """Callable returning (value, grads). grads is a pytree matching (args,
     kwargs) with arrays at differentiated tensor leaves and None elsewhere.
 
@@ -1308,11 +1309,6 @@ class ThunderValueAndGrad:
         self._cache[key] = entry
         return entry
 
-    from ..common import EpilogueMixin as _EM
-
-    _apply_effects = _EM.apply_effects
-    consume_pending_effects = _EM.consume_pending_effects
-
     def __call__(self, *args, **kwargs):
         import jax
         import jax.numpy as jnp
@@ -1323,6 +1319,9 @@ class ThunderValueAndGrad:
         leaves, treedef = tree_flatten((args, kwargs))
         tensor_mask = [_is_tensor_like(l) for l in leaves]
         key = _cache_key(leaves, tensor_mask)
+        extra = getattr(self.fn, "__cache_extra__", None)
+        if extra is not None:
+            key = key + (extra(),)  # e.g. module train/eval mode
         # Under an ambient jax trace (TrainStep's jit/shard_map), compiled
         # entries bake that trace's tracers as constants — they must not
         # outlive it. Key such entries by the tracer's trace identity so a
@@ -1341,7 +1340,7 @@ class ThunderValueAndGrad:
         out, saved = entry.fwd_fn(*tensor_leaves)
         if entry.effect_keys:
             out, effects = out
-            self._apply_effects(entry.effect_keys, effects)
+            self.apply_effects(entry.effect_keys, effects)
         # cotangent: scalar loss -> 1.0
         cot = jnp.ones((), dtype=jnp.asarray(out).dtype) if hasattr(out, "dtype") else 1.0
         grads_flat = entry.bwd_fn(*saved, cot)
@@ -1423,8 +1422,12 @@ class ModuleValueAndGrad:
         return self._vag._cs
 
     def __call__(self, *args, **kwargs):
-        params = self.tmodule.get_parameters()
-        loss, grads = self._vag(params, args, kwargs)
-        # grads mirrors ((params, args, kwargs), {}) -> params grads dict
-        param_grads = grads[0][0]
+        # buffers ride as (requires_grad=False) inputs so mutable state is
+        # not baked into the trace as constants (same as ThunderModule.__call__)
+        state = {**self.tmodule.get_parameters(), **self.tmodule.get_buffers()}
+        loss, grads = self._vag(state, args, kwargs)
+        # grads mirrors ((state, args, kwargs), {}) -> params grads dict
+        all_grads = grads[0][0]
+        param_names = set(self.tmodule.get_parameters())
+        param_grads = {k: g for k, g in all_grads.items() if k in param_names}
         return loss, param_grads
