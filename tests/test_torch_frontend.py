@@ -234,3 +234,84 @@ def test_unmapped_op_eager_fallback():
     loss, grads = tt.value_and_grad(cm)(jnp.asarray(x_t.numpy()))
     name = next(k for k in grads if k.endswith("lin.weight"))
     np.testing.assert_allclose(np.asarray(grads[name]), m.lin.weight.grad.numpy(), atol=1e-3)
+
+
+def test_inplace_methods_functionalized():
+    """In-place tensor methods (relu_/mul_/add_) run their functional
+    counterpart and rebind the receiver's proxy (the reference's interpreter
+    in-place functionalization, thunder/core/jit_ext.py)."""
+
+    class InplaceNet(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(8, 8)
+
+        def forward(self, x):
+            h = self.lin(x)
+            h = h.clone()
+            h.relu_()
+            h.mul_(2.0)
+            h.add_(1.0)
+            return h.sum()
+
+    m = InplaceNet()
+    x = torch.randn(4, 8)
+    out = float(tt.jit(m)(jnp.asarray(x.numpy())))
+    np.testing.assert_allclose(out, float(m(x)), rtol=1e-5)
+
+    # no functional counterpart -> still a loud error, not silent drop
+    class Bad(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y.exponential_()
+            return y.sum()
+
+    with pytest.raises(NotImplementedError):
+        tt.jit(Bad())(jnp.ones((4,), jnp.float32))
+
+
+def test_setitem_and_buffer_mutation_functionalized():
+    """y[mask]=v / y[1:3]=c rebind the receiver's proxy; in-place writes to
+    module buffers persist across calls via the epilogue."""
+
+    class Masked(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y[y > 1] = 0.0
+            y[0:1] = 5.0
+            return y.sum()
+
+    x = torch.arange(4.0)
+    out = float(tt.jit(Masked())(jnp.asarray(x.numpy())))
+    np.testing.assert_allclose(out, float(Masked()(x)))
+
+    class Counter(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("step", torch.zeros(()))
+
+        def forward(self, x):
+            self.step.add_(1.0)
+            return x.sum() + self.step
+
+    cm = tt.jit(Counter())
+    xs = jnp.ones((3,), jnp.float32)
+    assert [float(cm(xs)) for _ in range(3)] == [4.0, 5.0, 6.0]
+
+    class MF(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y.masked_fill_(y > 1, 0.0)  # statement form: effect must persist
+            return y.sum()
+
+    out3 = float(tt.jit(MF())(jnp.asarray(x.numpy())))
+    np.testing.assert_allclose(out3, float(MF()(x)))
+
+    class DtypeChange(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y.div_(2)  # int receiver: torch rejects; we must not silently cast
+            return y.sum()
+
+    with pytest.raises(NotImplementedError):
+        tt.jit(DtypeChange())(jnp.arange(4))

@@ -316,6 +316,24 @@ def _getitem(x, key):
     return clang.getitem(x, key)
 
 
+def _setitem_dispatch(args, kwargs):
+    """y[key] = value — functionalized: the receiver's proxy is rebound to
+    the updated tensor (boolean-mask and basic-index forms)."""
+    receiver, key, value = args[0], args[1], args[2]
+    if not isinstance(receiver, TraceTensor):
+        raise NotImplementedError("setitem on a non-traced tensor inside a trace")
+    rp = receiver.proxy
+    ukey = _unwrap(key)
+    uval = _unwrap(value)
+    if isinstance(ukey, TensorProxy) and ukey.dtype.is_bool:
+        # masked assignment: where(mask, value, y)
+        out = ltorch.where(ukey, uval, rp)
+        out = clang.maybe_convert_to_dtype(out, rp.dtype)
+    else:
+        out = prims.copy_with_setitem(rp, ukey, uval)
+    return _rebind_inplace(receiver, out, "__setitem__")
+
+
 @_register(torch.arange)
 def _arange(*args, dtype=None, device=None, **kw):
     return ltorch.arange(*args, dtype=to_tt_dtype(dtype) if dtype is not None else None)
@@ -732,11 +750,31 @@ def dispatch(func, args, kwargs):
         uargs = _unwrap(args)
         return meta_fn(*uargs, **_unwrap(kwargs))
 
+    if func is torch.Tensor.__setitem__:
+        return _setitem_dispatch(args, kwargs)
+    is_inplace = name.endswith("_") and not name.endswith("__")
     impl = _EXPLICIT.get(func)
     if impl is None and name in _DUNDER_MAP:
         impl = _DUNDER_MAP[name]
     if impl is None and name in _GENERIC_NAMES:
         impl = getattr(ltorch, name, None)
+    if is_inplace and args and isinstance(args[0], TraceTensor):
+        # in-place tensor method (x.add_(y), x.relu_(), x.masked_fill_(...)):
+        # run the functional counterpart and REBIND the receiver's proxy — the
+        # functionalization the reference does in its interpreter
+        # (thunder/core/jit_ext.py in-place handling). Explicit registrations
+        # of the in-place name (e.g. masked_fill_) resolve the impl but must
+        # go through the rebind too, or statement-form calls drop the effect.
+        base = name[:-1]
+        fimpl = (impl
+                 or _EXPLICIT.get(getattr(torch, base, None))
+                 or _EXPLICIT.get(getattr(torch.Tensor, base, None))
+                 or getattr(ltorch, base, None))
+        if fimpl is not None:
+            receiver = args[0]
+            out = fimpl(*_unwrap(args), **_unwrap(kwargs))
+            if isinstance(out, TensorProxy):
+                return _rebind_inplace(receiver, out, name)
     if impl is None:
         # auto-registered catalog (jax-lowered long tail: fft/linalg/special)
         impl = _auto_catalog_lookup(func, name)
@@ -755,6 +793,26 @@ def dispatch(func, args, kwargs):
 # ---------------------------------------------------------------------------
 # eager fallback for unmapped torch ops
 # ---------------------------------------------------------------------------
+
+def _rebind_inplace(receiver: "TraceTensor", out: TensorProxy, name: str) -> "TraceTensor":
+    """Functionalized in-place: replace the receiver's proxy with the result.
+    Shape/dtype must be preserved (torch rejects dtype-changing in-place ops).
+    Module-buffer receivers additionally record an epilogue side effect so
+    the mutation persists across calls."""
+    if tuple(out.shape) != tuple(receiver.proxy.shape):
+        raise NotImplementedError(f"in-place {name} would change the receiver's shape")
+    if out.dtype != receiver.proxy.dtype:
+        raise NotImplementedError(
+            f"in-place {name} would change the receiver's dtype "
+            f"({receiver.proxy.dtype.name} -> {out.dtype.name}); torch rejects this")
+    owner = getattr(receiver, "_owner", None)
+    if owner is not None:
+        trc = get_tracectx()
+        if trc is not None:
+            trc.side_effects.append((owner[0], owner[1], out))
+    receiver.proxy = out
+    return receiver
+
 
 def _auto_catalog_lookup(func, name: str):
     """Map a torch callable to an auto-registered jax symbol by qualified
@@ -973,12 +1031,24 @@ class TorchTracedModule:
         self.params = {n: torch_to_jax(p) for n, p in torch_module.named_parameters()}
         self.buffers = {n: torch_to_jax(b) for n, b in torch_module.named_buffers()}
 
+    @property
+    def _buffers(self):
+        # EpilogueMixin writes owner._buffers[name]; buffer mutations recorded
+        # as side effects land back here and persist across calls
+        return self.buffers
+
     def __call__(self, params: dict, args: tuple, kwargs: dict):
-        # wrap proxies as torch trace tensors; buffers ride as constants
+        # wrap proxies as torch trace tensors; buffers passed in `params`
+        # ride as inputs (mutations must not hit baked constants)
         wrapped_state = {k: TraceTensor(v) if isinstance(v, TensorProxy) else v
                          for k, v in params.items()}
         for k, v in self.buffers.items():
-            wrapped_state[k] = TraceTensor(clang.constant(v))
+            if k in params and isinstance(params[k], TensorProxy):
+                t = wrapped_state[k]
+            else:
+                t = TraceTensor(clang.constant(v))
+            t._owner = (self, k)  # in-place writes become epilogue effects
+            wrapped_state[k] = t
         wargs = tuple(TraceTensor(a) if isinstance(a, TensorProxy) else a for a in args)
         wkwargs = {k: TraceTensor(v) if isinstance(v, TensorProxy) else v for k, v in kwargs.items()}
         out = torch.func.functional_call(self.torch_module, wrapped_state, wargs, wkwargs)
@@ -1017,6 +1087,9 @@ class CompiledTorchModule:
     def get_parameters(self):
         return self.traced.params
 
+    def get_buffers(self):
+        return self.traced.buffers
+
     def __call__(self, *args, **kwargs):
         from collections.abc import Mapping
 
@@ -1037,7 +1110,7 @@ class CompiledTorchModule:
 
         args = tuple(conv(a) for a in args)
         kwargs = {k: conv(v) for k, v in kwargs.items()}
-        return self._cfn(self.traced.params, args, kwargs)
+        return self._cfn({**self.traced.params, **self.traced.buffers}, args, kwargs)
 
 
 def compile_torch_module(torch_module: torch.nn.Module, **jit_kwargs) -> CompiledTorchModule:

@@ -596,3 +596,243 @@ def all_(a, dim=None, keepdim=False):
 
 def cumsum(a, dim):
     return prims.cumsum(a, canonicalize_dim(a.ndim, dim))
+
+
+# ---------------------------------------------------------------------------
+# elementwise core-language wrappers (reference clang's elementwise family,
+# thunder/clang/__init__.py — thin delegations: normalization/promotion
+# happens in the prims metas; kept at clang level so the core language is
+# complete without reaching into ltorch)
+# ---------------------------------------------------------------------------
+
+
+def _unary(prim):
+    def op(a):
+        return prim(ensure_proxy(a))
+
+    op.__name__ = prim.name if hasattr(prim, "name") else getattr(prim, "__name__", "op")
+    return op
+
+
+abs = _unary(prims.abs)  # noqa: A001 — mirrors reference clang naming
+acos = _unary(prims.acos)
+acosh = _unary(prims.acosh)
+asin = _unary(prims.asin)
+asinh = _unary(prims.asinh)
+atan = _unary(prims.atan)
+atanh = _unary(prims.atanh)
+ceil = _unary(prims.ceil)
+cos = _unary(prims.cos)
+cosh = _unary(prims.cosh)
+digamma = _unary(prims.digamma)
+erf = _unary(prims.erf)
+erfc = _unary(prims.erfc)
+erfinv = _unary(prims.erfinv)
+exp = _unary(prims.exp)
+exp2 = _unary(prims.exp2)
+expm1 = _unary(prims.expm1)
+floor = _unary(prims.floor)
+isfinite = _unary(prims.isfinite)
+isnan = _unary(prims.isnan)
+lgamma = _unary(prims.lgamma)
+log = _unary(prims.log)
+log10 = _unary(prims.log10)
+log1p = _unary(prims.log1p)
+log2 = _unary(prims.log2)
+logical_not = _unary(prims.logical_not)
+neg = _unary(prims.neg)
+reciprocal = _unary(prims.reciprocal)
+round = _unary(prims.round)  # noqa: A001
+rsqrt = _unary(prims.rsqrt)
+sign = _unary(prims.sign)
+signbit = _unary(prims.signbit)
+sin = _unary(prims.sin)
+sinh = _unary(prims.sinh)
+sqrt = _unary(prims.sqrt)
+tan = _unary(prims.tan)
+tanh = _unary(prims.tanh)
+trunc = _unary(prims.trunc)
+
+
+def sigmoid(a):
+    return prims.reciprocal(add(prims.exp(prims.neg(ensure_proxy(a))), 1.0))
+
+
+def silu(a):
+    a = ensure_proxy(a)
+    return mul(a, sigmoid(a))
+
+
+def pow(a, b):  # noqa: A001
+    return _elementwise_binary(prims.pow, a, b)
+
+
+def copysign(a, b):
+    return _elementwise_binary(prims.copysign, a, b)
+
+
+def nextafter(a, b):
+    return _elementwise_binary(prims.nextafter, a, b)
+
+
+def zeta(a, b):
+    from ..ops.auto_register import get_auto_symbol
+
+    return get_auto_symbol("special_zeta")(ensure_proxy(a), ensure_proxy(b))
+
+
+def logical_xor(a, b):
+    return ne(maybe_convert_to_dtype(ensure_proxy(a), dtypes.bool8),
+              maybe_convert_to_dtype(ensure_proxy(b), dtypes.bool8))
+
+
+def bitwise_not(a):
+    return prims.bitwise_not(ensure_proxy(a))
+
+
+def bitwise_left_shift(a, b):
+    return prims.shift_left(ensure_proxy(a), ensure_proxy(b))
+
+
+def bitwise_right_shift(a, b):
+    return prims.shift_right(ensure_proxy(a), ensure_proxy(b))
+
+
+def mod(a, b):
+    return _elementwise_binary(prims.remainder, a, b)
+
+
+def trunc_divide(a, b):
+    return trunc(true_divide(a, b))
+
+
+def lerp(start, end, weight):
+    start, end = ensure_proxy(start), ensure_proxy(end)
+    return add(start, mul(weight, sub(end, start)))
+
+
+# ---------------------------------------------------------------------------
+# indexing / structure core ops
+# ---------------------------------------------------------------------------
+
+
+def gather(a, indices, dim):
+    """take_along_axis semantics (reference clang.gather)."""
+    return take_along_axis(a, indices, dim)
+
+
+def scatter(a, indices, src, dim):
+    from . import ltorch
+
+    return ltorch.scatter(a, dim, indices, src)
+
+
+def index_copy(a, dim, indices, src):
+    """Copy rows of src into a at positions `indices` along dim."""
+    from . import ltorch
+
+    d = canonicalize_dim(a.ndim, pyval(dim))
+    idx_shape = [1] * a.ndim
+    idx_shape[d] = -1
+    bshape = list(a.shape)
+    bshape[d] = indices.shape[0]
+    idx = expand(reshape(indices, tuple(idx_shape)), tuple(bshape))
+    return ltorch.scatter(a, d, idx, src)
+
+
+def index_put(a, indices, values, accumulate=False):
+    """a[indices] = values (or += with accumulate) — advanced-index write."""
+    from . import ltorch
+
+    a = ensure_proxy(a)
+    if len(indices) == 1 and not accumulate:
+        d = 0
+        idx = indices[0]
+        bshape = list(a.shape)
+        bshape[d] = idx.shape[0]
+        idx_shape = [1] * a.ndim
+        idx_shape[d] = -1
+        full_idx = expand(reshape(idx, tuple(idx_shape)), tuple(bshape))
+        src = values if tuple(values.shape) == tuple(bshape) else expand(values, tuple(bshape))
+        return ltorch.scatter(a, d, full_idx, src)
+    if len(indices) == 1 and accumulate:
+        idx = indices[0]
+        bshape = list(a.shape)
+        bshape[0] = idx.shape[0]
+        idx_shape = [1] * a.ndim
+        idx_shape[0] = -1
+        full_idx = expand(reshape(idx, tuple(idx_shape)), tuple(bshape))
+        src = values if tuple(values.shape) == tuple(bshape) else expand(values, tuple(bshape))
+        return scatter_add(a, full_idx, src, 0)
+    raise NotImplementedError("index_put with multiple index tensors")
+
+
+def diagonal(a, offset=0, dim1=0, dim2=1):
+    from . import ltorch
+
+    return ltorch.diagonal_op(a, offset, dim1, dim2)
+
+
+def sort(a, dim=-1, descending=False):
+    from . import ltorch
+
+    return ltorch.sort(a, dim, descending)
+
+
+def topk(a, k, dim=-1):
+    from . import ltorch
+
+    return ltorch.topk(a, k, dim)
+
+
+def unfold(a, dim, size, step):
+    """Sliding windows along `dim` (tensor.unfold semantics)."""
+    from ..ops.auto_register import get_auto_symbol
+
+    return get_auto_symbol("unfold_dim")(ensure_proxy(a), pyval(dim), pyval(size), pyval(step))
+
+
+def tensor_from_sequence(seq, *, dtype=None, device=None):
+    import numpy as _np
+
+    def conv(x):
+        if isinstance(x, NumberProxy):
+            return pyval(x)
+        if isinstance(x, (list, tuple)):
+            return [conv(e) for e in x]
+        return x
+
+    arr = _np.asarray(conv(list(seq)))
+    if dtype is not None:
+        arr = arr.astype(dtypes.to_jax_dtype(dtypes.to_dtype(dtype)))
+    elif arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)  # match jax x64-off default
+    elif arr.dtype == _np.int64:
+        arr = arr.astype(_np.int32)
+    return constant(arr)
+
+
+def empty(shape, *, dtype=dtypes.float32, device=None):
+    """Uninitialized-by-contract tensor (implemented as zeros: XLA has no
+    uninitialized allocation; the contract is only that values are unread)."""
+    return full(tuple(shape), 0, dtype=dtype, device=device)
+
+
+def uniform(shape, minval=0.0, maxval=1.0, *, dtype=dtypes.float32, device=None, key=None):
+    return prims.uniform(tuple(shape), minval, maxval, dtype=dtype, key=key)
+
+
+def uniform_like(a, minval=0.0, maxval=1.0, *, key=None):
+    return prims.uniform(tuple(a.shape), minval, maxval, dtype=a.dtype, key=key)
+
+
+def real(a):
+    from ..ops.auto_register import get_auto_symbol
+
+    return get_auto_symbol("real")(ensure_proxy(a))
+
+
+def imag(a):
+    from ..ops.auto_register import get_auto_symbol
+
+    return get_auto_symbol("imag")(ensure_proxy(a))

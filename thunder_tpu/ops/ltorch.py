@@ -2560,3 +2560,177 @@ def multilabel_soft_margin_loss(input, target, reduction="mean"):
     loss = prims.neg(clang.add(clang.mul(target, log_sig), clang.mul(clang.sub(1.0, target), log_sig_neg)))
     loss = clang.mean(loss, -1, False)
     return _apply_reduction(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# wave 4: reference-parity aliases & small composites
+# (reference thunder/torch/__init__.py long tail)
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="addcmul", method_names=("addcmul",))
+def addcmul(a, t1, t2, *, value=1.0):
+    return clang.add(a, clang.mul(value, clang.mul(t1, t2)))
+
+
+@torchsymbol(name="addcdiv", method_names=("addcdiv",))
+def addcdiv(a, t1, t2, *, value=1.0):
+    return clang.add(a, clang.mul(value, clang.true_divide(t1, t2)))
+
+
+@torchsymbol(name="lerp", method_names=("lerp",))
+def lerp(start, end, weight):
+    return clang.lerp(start, end, weight)
+
+
+@torchsymbol(name="ldexp", method_names=("ldexp",))
+def ldexp(a, other):
+    # a * 2**other, computed in float (torch promotes integer inputs)
+    a = clang.ensure_proxy(a)
+    if not a.dtype.is_float:
+        a = clang.maybe_convert_to_dtype(a, dtypes.float32)
+    other = clang.maybe_convert_to_dtype(clang.ensure_proxy(other), a.dtype) \
+        if isinstance(other, TensorProxy) else other
+    return clang.mul(a, clang.exp2(other))
+
+
+@torchsymbol(name="multi_dot")
+def multi_dot(tensors):
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = matmul(out, t)
+    return out
+
+
+@torchsymbol(name="view_as", method_names=("view_as",))
+def view_as(a, other):
+    return reshape(a, tuple(other.shape))
+
+
+@torchsymbol(name="true_divide", method_names=("true_divide",))
+def true_divide(a, b):
+    return clang.true_divide(a, b)
+
+
+@torchsymbol(name="real", method_names=("real",))
+def real(a):
+    return clang.real(a)
+
+
+@torchsymbol(name="imag", method_names=("imag",))
+def imag(a):
+    return clang.imag(a)
+
+
+@torchsymbol(name="polar")
+def polar(r, theta):
+    from .auto_register import get_auto_symbol
+
+    return get_auto_symbol("polar")(r, theta)
+
+
+@torchsymbol(name="view_as_real", method_names=("view_as_real",))
+def view_as_real(a):
+    from .auto_register import get_auto_symbol
+
+    return get_auto_symbol("view_as_real")(a)
+
+
+@torchsymbol(name="view_as_complex", method_names=("view_as_complex",))
+def view_as_complex(a):
+    from .auto_register import get_auto_symbol
+
+    return get_auto_symbol("view_as_complex")(a)
+
+
+@torchsymbol(name="polygamma", method_names=("polygamma",))
+def polygamma(n, a):
+    from .auto_register import get_auto_symbol
+
+    return get_auto_symbol("polygamma")(n, a)
+
+
+@torchsymbol(name="zeta")
+def zeta(a, b):
+    return clang.zeta(a, b)
+
+
+@torchsymbol(name="frexp", method_names=("frexp",))
+def frexp(a):
+    from .auto_register import get_auto_symbol
+
+    return get_auto_symbol("frexp")(a)
+
+
+@torchsymbol(name="index_copy", method_names=("index_copy",))
+def index_copy(a, dim, index, src):
+    return clang.index_copy(a, dim, index, src)
+
+
+@torchsymbol(name="index_put", method_names=("index_put",))
+def index_put(a, indices, values, accumulate=False):
+    return clang.index_put(a, tuple(indices), values, accumulate)
+
+
+@torchsymbol(name="uniform")
+def uniform(shape, minval=0.0, maxval=1.0, *, dtype=dtypes.float32, device=None, key=None):
+    return clang.uniform(shape, minval, maxval, dtype=dtype, device=device, key=key)
+
+
+@torchsymbol(name="uniform_like")
+def uniform_like(a, minval=0.0, maxval=1.0, *, key=None):
+    return clang.uniform_like(a, minval, maxval, key=key)
+
+
+# metadata predicates (trace-time constants, reference torch/__init__.py
+# is_floating_point/is_complex/numel/dim family)
+def is_floating_point(a) -> bool:
+    return a.dtype.is_float
+
+
+def is_complex(a) -> bool:
+    return a.dtype.is_complex
+
+
+def is_cuda(a) -> bool:
+    return False
+
+
+def is_cpu(a) -> bool:
+    return True
+
+
+def is_nested(a) -> bool:
+    return False
+
+
+def numel(a) -> int:
+    return a.numel
+
+
+def dim(a) -> int:
+    return a.ndim
+
+
+def sym_max(a, b):
+    return builtins.max(pyval(a) if isinstance(a, NumberProxy) else a,
+                        pyval(b) if isinstance(b, NumberProxy) else b)
+
+
+def sym_min(a, b):
+    return builtins.min(pyval(a) if isinstance(a, NumberProxy) else a,
+                        pyval(b) if isinstance(b, NumberProxy) else b)
+
+
+@torchsymbol(name="long", method_names=("long",))
+def long(a):
+    return clang.maybe_convert_to_dtype(a, dtypes.int64)
+
+
+@torchsymbol(name="tensor")
+def tensor(seq, *, dtype=None, device=None):
+    if isinstance(seq, (int, float, bool, NumberProxy)):
+        seq = [seq]
+        out = clang.tensor_from_sequence(seq, dtype=dtype, device=device)
+        return clang.squeeze(out, 0)
+    return clang.tensor_from_sequence(seq, dtype=dtype, device=device)

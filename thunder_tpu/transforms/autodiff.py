@@ -1402,9 +1402,10 @@ class TorchModuleValueAndGrad:
         return self._vag._cs
 
     def __call__(self, *args, **kwargs):
-        params = self.ctm.get_parameters()
-        loss, grads = self._vag(params, args, kwargs)
-        return loss, grads[0][0]
+        state = {**self.ctm.get_parameters(), **self.ctm.get_buffers()}
+        loss, grads = self._vag(state, args, kwargs)
+        param_names = set(self.ctm.get_parameters())
+        return loss, {k: g for k, g in grads[0][0].items() if k in param_names}
 
 
 class ModuleValueAndGrad:
