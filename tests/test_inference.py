@@ -67,3 +67,27 @@ def test_scan_decode_batch_change_then_loop(rng):
     inf.generate(p4, 6, scan_decode=True)
     out, _ = inf.generate(p4, 6, scan_decode=False)
     assert out.shape == (4, 18)
+
+
+def test_moe_generate_matches_full_recompute(rng):
+    """KV-cached generation over the Mixtral-style MoE decoder (the reference
+    inference harness drives MoE CausalLMs, benchmark_inference.py:1-11)."""
+    from thunder_tpu.models.moe import MoEConfig, MoEGPT
+
+    cfg = Config.from_name("tiny-llama2", block_size=64)
+    moe_cfg = MoEConfig(n_embd=cfg.n_embd, intermediate_size=160,
+                        n_expert=4, n_expert_per_token=2)
+    gpt = MoEGPT(cfg, moe_cfg, dtype=jnp.float32)
+    engine = GPTInference(gpt, dtype=jnp.float32)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 8)))
+
+    out, _ = engine.generate(prompt, max_new_tokens=5)
+    assert out.shape == (2, 13)
+
+    tm = tt.jit(gpt)
+    seq = prompt
+    for _ in range(5):
+        logits = tm(seq)
+        nxt = jnp.argmax(logits[:, -1], -1).astype(prompt.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))

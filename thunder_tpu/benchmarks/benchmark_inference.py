@@ -21,7 +21,18 @@ def run(args) -> dict:
 
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     cfg = Config.from_name(args.model_name, block_size=max(args.prompt_len + args.max_new_tokens, 128))
-    gpt = GPT(cfg, dtype=dtype)
+    if args.moe:
+        from thunder_tpu.models.moe import MoEConfig, MoEGPT
+
+        if args.moe_experts < 2:
+            raise SystemExit("--moe_experts must be >= 2")
+        moe_cfg = MoEConfig(n_embd=cfg.n_embd,
+                            intermediate_size=max(128, cfg.intermediate_size // args.moe_experts),
+                            n_expert=args.moe_experts,
+                            n_expert_per_token=min(2, args.moe_experts))
+        gpt = MoEGPT(cfg, moe_cfg, dtype=dtype)
+    else:
+        gpt = GPT(cfg, dtype=dtype)
     engine = GPTInference(gpt, dtype=dtype)
 
     rng = np.random.RandomState(0)
@@ -32,7 +43,7 @@ def run(args) -> dict:
     out, m = engine.generate(prompt, max_new_tokens=args.max_new_tokens, temperature=args.temperature)
 
     result = {
-        "model": args.model_name,
+        "model": args.model_name + ("+moe" if args.moe else ""),
         "batch_size": args.batch_size,
         "prompt_len": args.prompt_len,
         "new_tokens": m.n_new_tokens,
@@ -53,6 +64,8 @@ def main():
     p.add_argument("--max_new_tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--moe", action="store_true", help="Mixtral-style MoE decoder (models/moe.py)")
+    p.add_argument("--moe_experts", type=int, default=8)
     run(p.parse_args())
 
 

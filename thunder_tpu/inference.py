@@ -73,7 +73,8 @@ def cached_sdpa(q, k_cache, v_cache, pos, scale=None):
 
 
 class GPTInference:
-    """Greedy/temperature generation over a models.litgpt.GPT.
+    """Greedy/temperature generation over a models.litgpt.GPT or
+    models.moe.MoEGPT (Mixtral-style MoE decoder).
 
     The model's sdpa path is swapped for cache-aware attention by running the
     blocks manually (the GPT module structure is reused; no retracing of the
@@ -136,11 +137,17 @@ class GPTInference:
             y = cached_sdpa(q, kq, vq, pos)
             y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * hs))
             h = att.proj(y)
-            if cfg.parallel_residual:
-                x = x + h + block.mlp(block.norm_2(x))
+            mlp = getattr(block, "mlp", None)
+            is_moe = mlp is None
+            if is_moe:
+                mlp = block.moe  # MoE decoder blocks (models/moe.py MoEBlock)
+            if cfg.parallel_residual and not is_moe:
+                # MoEBlock.forward is always sequential (moe.py:92-93); only
+                # litgpt Blocks honor parallel_residual
+                x = x + h + mlp(block.norm_2(x))
             else:
                 x = x + h
-                x = x + block.mlp(block.norm_2(x))
+                x = x + mlp(block.norm_2(x))
         x = gpt.ln_f(x)
         logits = gpt.lm_head(x[:, -1])  # only last position needed for generation
         return logits, tuple(new_ks), tuple(new_vs)
