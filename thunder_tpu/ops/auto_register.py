@@ -423,6 +423,87 @@ def _cumtrapz(y, x, dim):
         avg = avg * dx
     return jnp.moveaxis(jnp.cumsum(avg, -1), -1, dim)
 
+# torch alias families + additional long tail — every name here is a REAL
+# torch callable name reachable through _auto_catalog_lookup (plain
+# torch.<name> / torch.special.<name> / torch.linalg.<name>) or the frontend
+# name-based generic path; no invented identifiers
+_CATALOG_DIFF.update({
+    "arccos": jnp.arccos,
+    "arccosh": jnp.arccosh,
+    "arcsin": jnp.arcsin,
+    "arcsinh": jnp.arcsinh,
+    "arctan": jnp.arctan,
+    "arctan2": jnp.arctan2,
+    "arctanh": jnp.arctanh,
+    "absolute": jnp.abs,
+    "negative": jnp.negative,
+    "subtract": lambda a, b, alpha=1.0: a - alpha * b,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "fix": jnp.fix,
+    "concat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+    "concatenate": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+    # activations (functional names the frontend resolves by __name__)
+    "relu6": jax.nn.relu6,
+    "softmin": lambda a, dim=-1: jax.nn.softmax(-a, axis=dim),
+    # losses (functional long tail)
+    "smooth_l1_loss": lambda a, b, reduction="mean", beta=1.0: _reduce(
+        jnp.where(jnp.abs(a - b) < beta, 0.5 * (a - b) ** 2 / beta,
+                  jnp.abs(a - b) - 0.5 * beta), reduction),
+    "soft_margin_loss": lambda a, y, reduction="mean": _reduce(
+        jnp.log1p(jnp.exp(-y * a)), reduction),
+    "gaussian_nll_loss": lambda mu, tgt, var, full=False, eps=1e-6, reduction="mean": _reduce(
+        0.5 * (jnp.log(jnp.maximum(var, eps)) + (tgt - mu) ** 2 / jnp.maximum(var, eps)),
+        reduction),
+    "triplet_margin_loss": lambda a, p, n, margin=1.0, reduction="mean": _reduce(
+        jnp.maximum(jnp.linalg.norm(a - p, axis=-1) - jnp.linalg.norm(a - n, axis=-1)
+                    + margin, 0.0), reduction),
+    "hinge_embedding_loss": lambda a, y, margin=1.0, reduction="mean": _reduce(
+        jnp.where(y > 0, a, jnp.maximum(0.0, margin - a)), reduction),
+    # legacy torch.* linalg names
+    "pinverse": jnp.linalg.pinv,
+    "inverse": jnp.linalg.inv,
+    "det": jnp.linalg.det,
+    "logdet": lambda a: jnp.where(jnp.linalg.slogdet(a)[0] > 0,
+                                  jnp.linalg.slogdet(a)[1], jnp.nan),
+    "slogdet": jnp.linalg.slogdet,
+    "cholesky": jnp.linalg.cholesky,
+    "qr": lambda a, some=True: jnp.linalg.qr(a, mode="reduced" if some else "complete"),
+    # torch.svd contract: A = U diag(S) V^T -> third output is V, not Vh
+    "svd": lambda a, some=True: (lambda u, s2, vh: (u, s2, jnp.swapaxes(vh, -2, -1)))(
+        *jnp.linalg.svd(a, full_matrices=not some)),
+    "matrix_rank": jnp.linalg.matrix_rank,
+    "dist": lambda a, b, p=2.0: jnp.linalg.norm(jnp.ravel(a - b), ord=p),
+    "orgqr": lambda a, tau: _householder_product(a, tau),
+    "nuclear_norm": lambda a: jnp.sum(jnp.linalg.svd(a, compute_uv=False)),
+    "frobenius_norm": lambda a: jnp.linalg.norm(a),
+    # reductions & statistics (real torch.* names)
+    "std_mean": lambda a, dim=None, correction=1, keepdim=False: (
+        jnp.std(a, axis=dim, ddof=correction, keepdims=keepdim),
+        jnp.mean(a, axis=dim, keepdims=keepdim)),
+    "var_mean": lambda a, dim=None, correction=1, keepdim=False: (
+        jnp.var(a, axis=dim, ddof=correction, keepdims=keepdim),
+        jnp.mean(a, axis=dim, keepdims=keepdim)),
+    "msort": lambda a: jnp.sort(a, axis=0),
+    "kthvalue": lambda a, k, dim=-1: (
+        jnp.sort(a, axis=dim).take(k - 1, axis=dim),
+        jnp.argsort(a, axis=dim).take(k - 1, axis=dim)),
+    "take": lambda a, idx: jnp.take(jnp.ravel(a), idx),
+    # torch.special extras
+    "special_softmax": lambda a, dim=-1: jax.nn.softmax(a, axis=dim),
+    "special_log_softmax": lambda a, dim=-1: jax.nn.log_softmax(a, axis=dim),
+    "i0": jax.scipy.special.i0,
+})
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
 _CATALOG_NONDIFF: dict[str, Callable] = {
     "searchsorted": lambda sorted_seq, values, right=False: jnp.searchsorted(
         sorted_seq, values, side="right" if right else "left"),
@@ -441,6 +522,13 @@ _CATALOG_NONDIFF: dict[str, Callable] = {
     "triu_indices": lambda row, col, offset=0: jnp.stack(jnp.triu_indices(row, offset, col)),
     "argwhere_size": lambda a, size: jnp.argwhere(a, size=size),  # static-size variant
     "float_power_int": lambda a, b: jnp.float_power(a, b),
+    # nondiff long tail (real torch.* names)
+    "isposinf": jnp.isposinf,
+    "isneginf": jnp.isneginf,
+    "eye": lambda n, m=None: jnp.eye(n, m),
+    "linspace": lambda start, end, steps: jnp.linspace(start, end, steps),
+    "logspace": lambda start, end, steps, base=10.0: jnp.logspace(start, end, steps, base=base),
+    "meshgrid": lambda *ts, indexing="ij": jnp.meshgrid(*ts, indexing=indexing),
 }
 
 
