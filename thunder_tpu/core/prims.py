@@ -65,6 +65,7 @@ class PrimIDs(Enum):
     CAT = auto()
     PAD = auto()
     FLIP = auto()
+    VAR = auto()
     TAKE = auto()
     TAKE_ALONG_AXIS = auto()
     INDEX_ADD = auto()
@@ -656,6 +657,23 @@ def _amax_meta(a, dims):
 
 
 amax = make_prim(PrimIDs.AMAX, "amax", _amax_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _var_meta(a, dims, *, correction=1):
+    out = _reduction_meta(a, dims)
+    if out.dtype.is_complex:
+        # variance of complex data is real (jnp.var semantics)
+        real_dt = dtypes.float64 if out.dtype == dtypes.complex128 else dtypes.float32
+        return TensorProxy(shape=out.shape, dtype=real_dt, device=out.device)
+    if not out.dtype.is_inexact:
+        return TensorProxy(shape=out.shape, dtype=dtypes.float32, device=out.device)
+    return out
+
+
+var_prim = make_prim(PrimIDs.VAR, "var", _var_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+
 amin = make_prim(PrimIDs.AMIN, "amin", _amax_meta, tags=(OpTags.REDUCTION_OP,))
 
 

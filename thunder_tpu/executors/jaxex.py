@@ -213,6 +213,18 @@ _reg(PrimIDs.WHERE, jnp.where)
 _reg(PrimIDs.SUM, lambda a, dims, *, output_dtype=None: jnp.sum(a, axis=dims, dtype=_jd(output_dtype)))
 _reg(PrimIDs.PROD, lambda a, dims, *, output_dtype=None: jnp.prod(a, axis=dims, dtype=_jd(output_dtype)))
 _reg(PrimIDs.AMAX, lambda a, dims: jnp.max(a, axis=dims))
+def _var_impl(a, dims, correction=1):
+    n = 1
+    for d in dims:
+        n *= a.shape[d]
+    m = jnp.mean(a, axis=dims, keepdims=True)
+    centered = a - m
+    sq = (centered * jnp.conj(centered)).real if jnp.iscomplexobj(a) else centered * centered
+    # torch divides by max(0, n - correction): inf for over-corrected counts
+    return jnp.sum(sq, axis=dims) / max(0, n - correction)
+
+
+_reg(PrimIDs.VAR, _var_impl)
 _reg(PrimIDs.AMIN, lambda a, dims: jnp.min(a, axis=dims))
 _reg(PrimIDs.ARGMAX, lambda a, dim: jnp.argmax(a, axis=dim).astype(_jd(dtypes.int64)))
 _reg(PrimIDs.ARGMIN, lambda a, dim: jnp.argmin(a, axis=dim).astype(_jd(dtypes.int64)))

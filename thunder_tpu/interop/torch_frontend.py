@@ -523,6 +523,8 @@ _GENERIC_NAMES = {
     "bitwise_xor", "bitwise_not", "logical_xor", "xlogy", "heaviside",
     "prod", "isinf", "signbit", "kron",
     "tensordot", "dot", "mv", "vdot", "outer", "rsub",
+    "soft_margin_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "relu6", "softmin",
 }
 
 _DUNDER_MAP = {

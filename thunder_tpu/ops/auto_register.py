@@ -444,28 +444,17 @@ _CATALOG_DIFF.update({
     "concat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     "concatenate": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     # activations (functional names the frontend resolves by __name__)
-    "relu6": jax.nn.relu6,
-    "softmin": lambda a, dim=-1: jax.nn.softmax(-a, axis=dim),
     # losses (functional long tail)
-    "smooth_l1_loss": lambda a, b, reduction="mean", beta=1.0: _reduce(
-        jnp.where(jnp.abs(a - b) < beta, 0.5 * (a - b) ** 2 / beta,
-                  jnp.abs(a - b) - 0.5 * beta), reduction),
-    "soft_margin_loss": lambda a, y, reduction="mean": _reduce(
-        jnp.log1p(jnp.exp(-y * a)), reduction),
     "gaussian_nll_loss": lambda mu, tgt, var, full=False, eps=1e-6, reduction="mean": _reduce(
         0.5 * (jnp.log(jnp.maximum(var, eps)) + (tgt - mu) ** 2 / jnp.maximum(var, eps)),
         reduction),
-    "triplet_margin_loss": lambda a, p, n, margin=1.0, reduction="mean": _reduce(
-        jnp.maximum(jnp.linalg.norm(a - p, axis=-1) - jnp.linalg.norm(a - n, axis=-1)
-                    + margin, 0.0), reduction),
-    "hinge_embedding_loss": lambda a, y, margin=1.0, reduction="mean": _reduce(
-        jnp.where(y > 0, a, jnp.maximum(0.0, margin - a)), reduction),
     # legacy torch.* linalg names
     "pinverse": jnp.linalg.pinv,
     "inverse": jnp.linalg.inv,
     "det": jnp.linalg.det,
-    "logdet": lambda a: jnp.where(jnp.linalg.slogdet(a)[0] > 0,
-                                  jnp.linalg.slogdet(a)[1], jnp.nan),
+    "logdet": lambda a: (lambda sign, logabs: jnp.where(
+        sign > 0, logabs, jnp.where(sign == 0, -jnp.inf, jnp.nan)))(
+        *jnp.linalg.slogdet(a)),
     "slogdet": jnp.linalg.slogdet,
     "cholesky": jnp.linalg.cholesky,
     "qr": lambda a, some=True: jnp.linalg.qr(a, mode="reduced" if some else "complete"),
@@ -493,6 +482,7 @@ _CATALOG_DIFF.update({
     "special_softmax": lambda a, dim=-1: jax.nn.softmax(a, axis=dim),
     "special_log_softmax": lambda a, dim=-1: jax.nn.log_softmax(a, axis=dim),
     "i0": jax.scipy.special.i0,
+    "meshgrid": lambda *ts, indexing="ij": jnp.meshgrid(*ts, indexing=indexing),
 })
 
 
@@ -525,10 +515,6 @@ _CATALOG_NONDIFF: dict[str, Callable] = {
     # nondiff long tail (real torch.* names)
     "isposinf": jnp.isposinf,
     "isneginf": jnp.isneginf,
-    "eye": lambda n, m=None: jnp.eye(n, m),
-    "linspace": lambda start, end, steps: jnp.linspace(start, end, steps),
-    "logspace": lambda start, end, steps, base=10.0: jnp.logspace(start, end, steps, base=base),
-    "meshgrid": lambda *ts, indexing="ij": jnp.meshgrid(*ts, indexing=indexing),
 }
 
 

@@ -537,15 +537,13 @@ def mean(a, dim=None, keepdim=False, *, dtype=None):
 
 def var(a, dim=None, keepdim=False, *, correction=1):
     dims = _reduction_dims(a, dim)
-    count = 1
-    for d in dims:
-        count *= a.shape[d]
-    m = mean(a, dim, keepdim=True)
-    centered = sub(a, m)
-    sq = mul(centered, centered)
-    s = sum_(sq, dim, keepdim)
-    denom = max(0, count - correction)
-    return true_divide(s, denom)
+    out = prims.var_prim(a, dims, correction=correction)
+    if keepdim:
+        shape = list(a.shape)
+        for d in dims:
+            shape[d] = 1
+        out = reshape(out, tuple(shape))
+    return out
 
 
 def var_mean(a, dim=None, keepdim=False, *, correction=1):

@@ -514,6 +514,8 @@ def getitem(a, key):
 
 @torchsymbol(name="index_select", method_names=("index_select",))
 def index_select(a, dim, index):
+    # lowers to the TAKE prim (hand-written grad rule) — a dedicated
+    # INDEX_SELECT prim would duplicate it
     return clang.take(a, index, pyval(dim))
 
 

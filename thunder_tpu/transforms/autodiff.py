@@ -68,6 +68,7 @@ JAX_VJP_FALLBACK: set = {
     PrimIDs.CONVOLUTION, PrimIDs.GROUPED_MM, PrimIDs.ATAN2, PrimIDs.CUMSUM,
     PrimIDs.CUMPROD, PrimIDs.REDUCE_WINDOW, PrimIDs.CONV_TRANSPOSE, PrimIDs.EINSUM,
     PrimIDs.DIGAMMA, PrimIDs.SCATTER, PrimIDs.COPY_WITH_SETITEM,
+    PrimIDs.VAR,
 }
 
 
