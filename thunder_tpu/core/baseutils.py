@@ -77,3 +77,11 @@ class SymbolInterface:
 
 class TraceInterface:
     pass
+
+
+def is_tensor_like(x) -> bool:
+    """True for concrete arrays (jax/numpy/Parameter): `.shape` must be an
+    actual tuple — modules (numpy), array TYPES, and function objects also
+    expose shape/dtype attributes. Proxies are excluded by callers that need
+    to distinguish them."""
+    return isinstance(getattr(x, "shape", None), tuple) and hasattr(x, "dtype")

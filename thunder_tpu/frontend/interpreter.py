@@ -251,6 +251,9 @@ class Interpreter:
         self.on_sharp_edge = on_sharp_edge or (lambda msg: None)
         self.max_depth = max_depth
         self.depth = 0
+        # cells reachable from the ROOT callable's __closure__ (id -> root
+        # freevar name): only these are prologue-re-derivable captures
+        self._root_cells: dict[int, str] = {}
         # instruction logging (reference interpreter.py:457 — every interpreted
         # instruction recorded; rendered by print_last_interpreter_log)
         self.log: list[str] = []
@@ -292,6 +295,7 @@ class Interpreter:
              fn_prov: Provenance = OPAQUE_PROVENANCE) -> WrappedValue:
         """args/kwargs are WrappedValues (or raw); returns a WrappedValue."""
         raw_fn = unwrap(fn)
+        self._note_root_cells(raw_fn)
         la = self.lookasides.get(raw_fn)
         if la is not None:
             res = la(*[unwrap(a) for a in args], **{k: unwrap(v) for k, v in kwargs.items()})
@@ -322,6 +326,16 @@ class Interpreter:
         # opaque: execute natively with unwrapped values (proxies flow through)
         res = raw_fn(*[unwrap(a) for a in args], **{k: unwrap(v) for k, v in kwargs.items()})
         return wrap(res, Provenance("op"))
+
+    def _note_root_cells(self, fn):
+        if self.depth != 0:
+            return
+        self._root_cells = {}
+        if isinstance(fn, types.FunctionType) and fn.__closure__:
+            # hold the cell objects: identity must be checked against the
+            # live cell (a bare id() could alias a collected cell's address)
+            self._root_cells = {id(cell): (name, cell) for name, cell in
+                                zip(fn.__code__.co_freevars, fn.__closure__)}
 
     def interpret_function(self, fn: types.FunctionType, args, kwargs,
                            fn_prov: Provenance = OPAQUE_PROVENANCE) -> WrappedValue:
@@ -491,9 +505,15 @@ class Interpreter:
         except ValueError:
             raise UnboundLocalError(f"cell variable '{name}' is empty")
         # cells hold RAW values (they are shared with natively-executing
-        # closures); only genuine captured state (freevars) is unpackable
-        if name in frame.code.co_freevars:
-            frame.push(self._loaded(v, Provenance("closure", name)))
+        # closures); only cells reachable from the ROOT callable's __closure__
+        # are unpackable — the prologue re-derives captures from
+        # fn.__closure__, and nested interpreted functions (decorators,
+        # dataclass-generated code) may carry cells the root cannot reach.
+        # Identity (not depth) is the test: a helper at depth 2 reading the
+        # root's own cell must still capture it, under the ROOT's name.
+        entry = self._root_cells.get(id(cell))
+        if entry is not None and entry[1] is cell:
+            frame.push(self._loaded(v, Provenance("closure", entry[0])))
         else:
             frame.push(wrap(v, Provenance("op")))
         return None
@@ -913,7 +933,11 @@ class Interpreter:
         if kwdefaults:
             new_fn.__kwdefaults__ = kwdefaults
         if annotations:
-            new_fn.__annotations__ = dict(annotations) if not isinstance(annotations, dict) else annotations
+            if isinstance(annotations, dict):
+                new_fn.__annotations__ = annotations
+            else:
+                # 3.12 pushes a flat (name, value, name, value, ...) tuple
+                new_fn.__annotations__ = dict(zip(annotations[::2], annotations[1::2]))
         frame.push(wrap(new_fn, Provenance("op")))
         return None
 

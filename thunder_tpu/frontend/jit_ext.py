@@ -32,7 +32,8 @@ from .interpreter import (
 
 
 def _is_tensor_like(x) -> bool:
-    return hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, Proxy)
+    from ..core.baseutils import is_tensor_like as _itl
+    return _itl(x) and not isinstance(x, Proxy)
 
 
 def _unwrap_param(x):

@@ -538,12 +538,7 @@ def mean(a, dim=None, keepdim=False, *, dtype=None):
 def var(a, dim=None, keepdim=False, *, correction=1):
     dims = _reduction_dims(a, dim)
     out = prims.var_prim(a, dims, correction=correction)
-    if keepdim:
-        shape = list(a.shape)
-        for d in dims:
-            shape[d] = 1
-        out = reshape(out, tuple(shape))
-    return out
+    return _maybe_keepdim(out, a, dims, keepdim)
 
 
 def var_mean(a, dim=None, keepdim=False, *, correction=1):
