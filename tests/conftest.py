@@ -31,3 +31,13 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+def pytest_collection_modifyitems(config, items):
+    # TT_TEST_ORDER_SEED=<int> runs the suite in a seeded random order to
+    # flush out cross-test global-state leaks (registry/cache pollution).
+    seed = os.environ.get("TT_TEST_ORDER_SEED")
+    if seed:
+        import random
+
+        random.Random(int(seed)).shuffle(items)

@@ -192,6 +192,77 @@ def test_batchnorm_running_stats_epilogue():
                                0.1 * x_np.mean(axis=(0, 2, 3)), atol=1e-5)
 
 
+def test_trainstep_rekeys_on_mode_flip(rng):
+    """train()/eval() flips AFTER TrainStep built its program must select a
+    mode-matching program, not silently run the cached train-mode one
+    (advisor r2: training.py TrainStep was keyed on shapes only)."""
+    import torch
+
+    from thunder_tpu import optim
+    from thunder_tpu.models.resnet import BatchNorm2d
+    from thunder_tpu.ops import ltorch as lt
+    from thunder_tpu.training import TrainStep
+
+    class BNNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.bn = BatchNorm2d(3)
+            self.fc = nn.Linear(3 * 8 * 8, 4, seed=0)
+
+        def forward(self, x, y):
+            h = self.bn(x)
+            h = lt.reshape(h, (x.shape[0], -1))
+            return lt.mse_loss(self.fc(h), y)
+
+    x = jnp.asarray(rng.randn(4, 3, 8, 8).astype(np.float32))
+    y = jnp.zeros((4, 4), jnp.float32)
+    net = BNNet()
+    step = TrainStep(net, optim.SGD(lr=0.0))  # lr=0: isolate buffer effects
+    step(x, y)  # builds the train-mode program; updates running stats
+    mean_after_train = np.asarray(net.bn._buffers["running_mean"]).copy()
+
+    net.eval()
+    loss_eval = float(step(x, y))
+    # eval program: running stats must NOT move
+    np.testing.assert_array_equal(
+        np.asarray(net.bn._buffers["running_mean"]), mean_after_train)
+
+    # flip back: the train program resumes mutating stats (mode cache reuse)
+    net.train()
+    step(x, y)
+    assert not np.allclose(
+        np.asarray(net.bn._buffers["running_mean"]), mean_after_train)
+
+    # and the eval loss actually used running-stat normalization
+    import math
+
+    net.eval()
+    loss_eval2 = float(step(x, y))
+    assert not math.isnan(loss_eval) and not math.isnan(loss_eval2)
+    assert abs(loss_eval2 - loss_eval) > 1e-9  # stats moved between evals
+
+
+def test_unconsumed_epilogue_effects_warn(rng):
+    """Wrapping a buffer-mutating compiled module in a user jax.jit loses the
+    buffer updates — that must warn, not silently drop (advisor r2:
+    common.py EpilogueMixin)."""
+    import warnings
+
+    import jax
+
+    from thunder_tpu.models.resnet import BatchNorm2d
+
+    x = jnp.asarray(rng.randn(4, 3, 8, 8).astype(np.float32))
+    bn = BatchNorm2d(3)
+    tm = tt.jit(bn)
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        jax.jit(lambda a: tm(a))(x)
+    assert any("buffer" in str(wi.message) and "LOST" in str(wi.message) for wi in w), \
+        [str(wi.message) for wi in w]
+
+
 def test_train_eval_mode_participates_in_cache_key(rng):
     """eval() after a train-mode trace must retrace, not hit the stale cached
     training program (which would keep mutating running stats)."""

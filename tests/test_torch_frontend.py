@@ -315,3 +315,63 @@ def test_setitem_and_buffer_mutation_functionalized():
 
     with pytest.raises(NotImplementedError):
         tt.jit(DtypeChange())(jnp.arange(4))
+
+
+def test_masked_setitem_element_placement():
+    """y[mask] = v with a 1-D v of mask.sum() elements places elements in
+    row-major order (torch semantics; advisor r2 finding)."""
+    import torch
+
+    class Place(torch.nn.Module):
+        def forward(self, x, v):
+            y = x.clone()
+            y[y > 0] = v
+            return y
+
+    x = torch.tensor([[-1.0, 2.0], [3.0, -4.0]])
+    v = torch.tensor([10.0, 20.0])
+    ref = Place()(x, v)
+    out = tt.jit(Place())(jnp.asarray(x.numpy()), jnp.asarray(v.numpy()))
+    np.testing.assert_allclose(np.asarray(out), ref.numpy())
+
+    # scalar fill still works
+    class Fill(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y[y > 0] = 0.5
+            return y
+
+    ref2 = Fill()(x)
+    out2 = tt.jit(Fill())(jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(out2), ref2.numpy())
+
+    # 2-D value: clear NotImplementedError, not a broadcast RuntimeError
+    class Bad(torch.nn.Module):
+        def forward(self, x, v):
+            y = x.clone()
+            y[y > 0] = v
+            return y
+
+    with pytest.raises(NotImplementedError, match="element placement|1-D"):
+        tt.jit(Bad())(jnp.asarray(x.numpy()), jnp.ones((2, 2), jnp.float32))
+
+
+def test_eager_fallback_int_dtype_with_x64_disabled():
+    """An unmapped torch op with integer outputs must produce specs matching
+    runtime arrays when jax x64 is off (advisor r2: int64 spec truncation)."""
+    import jax
+    import torch
+
+    class Buck(torch.nn.Module):
+        def forward(self, x, bounds):
+            idx = torch.bucketize(x, bounds)  # int64 out in torch
+            return idx * 2
+
+    x_np = np.array([0.2, 2.5, 7.0], np.float32)
+    b_np = np.array([1.0, 3.0, 5.0], np.float32)
+    ref = Buck()(torch.tensor(x_np), torch.tensor(b_np)).numpy()
+
+    with jax.enable_x64(False):
+        out = tt.jit(Buck())(jnp.asarray(x_np), jnp.asarray(b_np))
+        got = np.asarray(out)
+    np.testing.assert_array_equal(got, ref)

@@ -128,13 +128,25 @@ def forward(cfg, params, idx, targets, cos, sin, compute_dtype=jnp.bfloat16):
         if ng != nh:
             k = jnp.repeat(k, q_per_kv, axis=1)
             v = jnp.repeat(v, q_per_kv, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) / math.sqrt(hs)
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(compute_dtype)
-        y = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-        y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
+        # the attention a jax user writes today: the library's fused composite
+        # (falls back to manual softmax on jax versions without it)
+        if hasattr(jax.nn, "dot_product_attention"):
+            # rope promotes q/k to f32 (f32 cos/sin); the composite requires
+            # uniform dtypes
+            y = jax.nn.dot_product_attention(
+                q.astype(compute_dtype).transpose(0, 2, 1, 3),
+                k.astype(compute_dtype).transpose(0, 2, 1, 3),
+                v.astype(compute_dtype).transpose(0, 2, 1, 3),
+                scale=1.0 / math.sqrt(hs), is_causal=True)
+            y = y.reshape(B, T, nh * hs)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / math.sqrt(hs)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(compute_dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+            y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
         y = y @ w(f"{blk}.attn.proj.weight").T
         if f"{blk}.attn.proj.bias" in params:
             y = y + w(f"{blk}.attn.proj.bias")

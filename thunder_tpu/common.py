@@ -65,6 +65,22 @@ class EpilogueMixin:
         import jax as _jax
 
         if any(isinstance(e, _jax.core.Tracer) for e in effects):
+            # a known enclosing program (TrainStep, gspmd_step) will call
+            # consume_pending_effects(); anything else — e.g. a user wrapping
+            # the module call in their own jax.jit — silently loses the
+            # buffer updates, so say so loudly
+            if not getattr(self, "_effects_consumer_attached", False):
+                import warnings
+
+                warnings.warn(
+                    "this function mutates module buffers (e.g. BatchNorm "
+                    "running stats) and is being traced by an ambient jax "
+                    "transformation (jax.jit/shard_map) that will not apply "
+                    "them — the buffer updates will be LOST. Use "
+                    "thunder_tpu.training.TrainStep, or call the compiled "
+                    "module outside jax.jit.",
+                    stacklevel=3,
+                )
             self._pending_effects = (effect_keys, tuple(effects))
             return
         for (owner, name), value in zip(effect_keys, effects):

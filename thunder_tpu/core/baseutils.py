@@ -83,5 +83,13 @@ def is_tensor_like(x) -> bool:
     """True for concrete arrays (jax/numpy/Parameter): `.shape` must be an
     actual tuple — modules (numpy), array TYPES, and function objects also
     expose shape/dtype attributes. Proxies are excluded by callers that need
-    to distinguish them."""
-    return isinstance(getattr(x, "shape", None), tuple) and hasattr(x, "dtype")
+    to distinguish them.
+
+    The probe must tolerate hostile ``__getattr__``s: e.g. torch's
+    ``_ClassNamespace`` (``torch.classes.*``) raises RuntimeError, not
+    AttributeError, for unknown attributes, and such objects can appear in
+    globals walked by the prologue capture."""
+    try:
+        return isinstance(getattr(x, "shape", None), tuple) and hasattr(x, "dtype")
+    except Exception:
+        return False

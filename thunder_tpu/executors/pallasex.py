@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -282,9 +283,14 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=
     # a pallas round-trip (measured on v5e: composite wins at T<=2048, flash
     # wins >=2x at T=8192). But the composite materializes B*H*T*T scores —
     # at T=2048 claim flash once that tensor is big enough to pressure HBM.
+    # TT_FLASH_SDPA overrides the heuristic: "0" never claims (composite
+    # path), "1" claims whenever the tiling fits (benchmark/profiling A/B)
+    override = os.environ.get("TT_FLASH_SDPA")
+    if override == "0":
+        return False
     T = q.shape[-2]
     score_bytes = q.shape[0] * q.shape[1] * T * T * 2
-    long_enough = T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
+    long_enough = (override == "1") or T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
     shapes_ok = (
         q.shape[-1] <= 512  # any head dim (zero-padded to the 128 lane)
         and long_enough

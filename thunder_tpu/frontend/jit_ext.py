@@ -14,6 +14,7 @@ computation as constants and guarded by value checks in the prologue
 """
 from __future__ import annotations
 
+import types
 import warnings
 from typing import Any, Callable, NamedTuple, Optional, Sequence
 
@@ -93,6 +94,10 @@ class GeneralJitCtx:
     _MAX_CONTAINER_DEPTH = 3
 
     def _proxify(self, value: Any, prov: Provenance, depth: int) -> Any:
+        if isinstance(value, types.ModuleType):
+            # modules are never tensors/containers-of-tensors; skipping them
+            # keeps walks over e.g. sys.modules cheap and side-effect free
+            return value
         raw = _unwrap_param(value)
         if _is_tensor_like(raw):
             key = _prov_key(prov)

@@ -326,8 +326,34 @@ def _setitem_dispatch(args, kwargs):
     ukey = _unwrap(key)
     uval = _unwrap(value)
     if isinstance(ukey, TensorProxy) and ukey.dtype.is_bool:
-        # masked assignment: where(mask, value, y)
-        out = ltorch.where(ukey, uval, rp)
+        if isinstance(uval, TensorProxy) and uval.ndim >= 1:
+            # torch element placement: y[mask] = v with v a 1-D tensor of
+            # mask.sum() elements assigned to the selected positions in
+            # row-major order. Static-shape lowering: the k-th True position
+            # reads v[(cumsum(mask)-1)[pos]]; False lanes keep y. A runtime
+            # v-length mismatch (torch raises) cannot be checked at trace
+            # time — indices are clamped into v instead.
+            if uval.ndim != 1 or tuple(ukey.shape) != tuple(rp.shape):
+                raise NotImplementedError(
+                    "torch frontend: y[mask] = v supports a scalar v, a "
+                    "broadcastable v, or a 1-D v with mask.shape == y.shape "
+                    "(element placement); got mask shape "
+                    f"{tuple(ukey.shape)}, value shape {tuple(uval.shape)} "
+                    f"for receiver {tuple(rp.shape)}")
+            if int(uval.shape[0]) == 0:
+                # torch: y[mask] = empty v is a no-op iff mask selects nothing
+                # (else it raises at runtime — unverifiable at trace time)
+                out = rp
+            else:
+                flat_mask = ltorch.reshape(ukey, -1)
+                pos = ltorch.sub(ltorch.cumsum(ltorch.to(flat_mask, tt_dtypes.int32), 0), 1)
+                pos = ltorch.clamp(pos, 0, int(uval.shape[0]) - 1)
+                gathered = ltorch.index_select(uval, 0, pos)
+                flat = ltorch.where(flat_mask, gathered, ltorch.reshape(rp, -1))
+                out = ltorch.reshape(flat, tuple(rp.shape))
+        else:
+            # masked fill: where(mask, value, y)
+            out = ltorch.where(ukey, uval, rp)
         out = clang.maybe_convert_to_dtype(out, rp.dtype)
     else:
         out = prims.copy_with_setitem(rp, ukey, uval)
@@ -883,7 +909,19 @@ def _meta_result_specs(func, arrays, rebuild):
 
     def to_spec(x):
         if isinstance(x, torch.Tensor):
-            return jax.ShapeDtypeStruct(tuple(x.shape), jnp.dtype(tt_dtypes.to_jax_dtype(to_tt_dtype(x.dtype))))
+            jd = jnp.dtype(tt_dtypes.to_jax_dtype(to_tt_dtype(x.dtype)))
+            if not jax.config.jax_enable_x64:
+                # with x64 off jax would silently truncate 64-bit callback
+                # results (or reject the spec); downcast the spec so runtime
+                # arrays match the traced metadata (mirrors
+                # tensor_from_sequence's x64-off downcast)
+                jd = {
+                    jnp.dtype("int64"): jnp.dtype("int32"),
+                    jnp.dtype("uint64"): jnp.dtype("uint32"),
+                    jnp.dtype("float64"): jnp.dtype("float32"),
+                    jnp.dtype("complex128"): jnp.dtype("complex64"),
+                }.get(jd, jd)
+            return jax.ShapeDtypeStruct(tuple(x.shape), jd)
         return x
 
     return jax.tree_util.tree_map(to_spec, out, is_leaf=lambda x: isinstance(x, torch.Tensor))

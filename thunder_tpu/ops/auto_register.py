@@ -440,7 +440,7 @@ _CATALOG_DIFF.update({
     "subtract": lambda a, b, alpha=1.0: a - alpha * b,
     "multiply": jnp.multiply,
     "divide": jnp.divide,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,  # torch.fix aliases trunc; jnp.fix is deprecated (JAX 0.10 removal)
     "concat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     "concatenate": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     # activations (functional names the frontend resolves by __name__)

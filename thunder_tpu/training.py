@@ -38,6 +38,41 @@ class TrainStep:
         self._jitted: Optional[Callable] = None
         self.opt_state = None
         self._step_count = 0
+        # built programs are mode-specific (train/eval flips change the traced
+        # program — BatchNorm/Dropout branches — without changing any input
+        # metadata); key the whole compiled-program set on the module-mode
+        # tuple so a flip selects/rebuilds instead of silently running stale
+        self._mode_cache: dict = {}
+        self._active_mode = self._mode_key()
+
+    # every compiled artifact + trace-derived metadata that depends on the
+    # module's train/eval mode (the FSDP param gather is shape-only and is
+    # deliberately NOT mode-keyed)
+    _MODE_STATE_ATTRS = (
+        "_jitted", "_vag", "_effect_keys", "_micro_jitted", "_jitted_with_acc_fn",
+        "_vag_nosync", "_micro_dist_jitted", "_fold_dist_jitted", "_vag_full",
+        "_micro_fsdp_jitted", "_fold_fsdp_jitted",
+    )
+
+    def _mode_key(self):
+        extra = getattr(self.tmodule._cfn._cd.fn, "__cache_extra__", None)
+        return extra() if extra is not None else None
+
+    def _sync_mode(self):
+        key = self._mode_key()
+        if key == self._active_mode:
+            return
+        if self._grad_acc is not None:
+            raise RuntimeError(
+                "module train/eval mode changed in the middle of a no_sync "
+                "gradient-accumulation window; finish the window (a syncing "
+                "step) before flipping the mode")
+        self._mode_cache[self._active_mode] = {
+            a: getattr(self, a, None) for a in self._MODE_STATE_ATTRS}
+        stash = self._mode_cache.get(key) or {a: None for a in self._MODE_STATE_ATTRS}
+        for a, v in stash.items():
+            setattr(self, a, v)
+        self._active_mode = key
 
     def _make_vag(self, *, sync_loss: bool = True):
         """Build a ThunderValueAndGrad over the (optionally distributed)
@@ -85,7 +120,9 @@ class TrainStep:
         # argnums=0: the trainable params dict is arg 0 of the traced wrapper;
         # inside the jitted step params are raw arrays, so positional marking
         # is required
-        return ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
+        vag = ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
+        vag._effects_consumer_attached = True  # TrainStep consumes pending effects
+        return vag
 
     def _build(self, batch_args, batch_kwargs):
         plan = getattr(self.tmodule, "_dist_plan", None)
@@ -137,6 +174,7 @@ class TrainStep:
         return trainable, frozen
 
     def __call__(self, *args, **kwargs):
+        self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
             return self.micro_step(*args, **kwargs)
         trainable, frozen = self._split_params()
@@ -184,6 +222,7 @@ class TrainStep:
         gradients ride in a device-axis-sharded accumulator, so a K-step
         window costs ONE all-reduce instead of K (reference no_sync +
         _sync_grads, thunder/distributed/__init__.py:36,118)."""
+        self._sync_mode()
         plan = getattr(self.tmodule, "_dist_plan", None)
         if plan is not None:
             return self._micro_step_dist(plan, args, kwargs)
@@ -310,7 +349,9 @@ class TrainStep:
             return inner({**frozen_full, **tfull}, args, kwargs)
 
         traced_full.__name__ = f"nosync_{getattr(inner, '__name__', 'step')}"
-        return ThunderValueAndGrad(traced_full, argnums=0, transforms=self.tmodule._cfn._transforms)
+        vag = ThunderValueAndGrad(traced_full, argnums=0, transforms=self.tmodule._cfn._transforms)
+        vag._effects_consumer_attached = True
+        return vag
 
     def _gather_full(self, plan, tparam_arrays, frozen_arrays):
         """One jitted gather of every sharded param to full (unpadded) form."""
