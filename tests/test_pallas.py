@@ -23,25 +23,33 @@ def _ref_attn(q, k, v, causal=True, scale=None):
     return jax.nn.softmax(s, -1) @ v
 
 
+@pytest.mark.parametrize("dtype,atol", [(np.float32, 2e-3), (jnp.bfloat16, 3e-2)])
 @pytest.mark.parametrize("D", [64, 128])
-def test_flash_forward_matches_reference(rng, D):
+def test_flash_forward_matches_reference(rng, D, dtype, atol):
+    # bf16 exercises the low-precision MXU path (p cast to the value dtype
+    # before the pv dot); f32 inputs make those casts identity no-ops
     B, H, T = 2, 3, 256
-    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) for _ in range(3))
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32), dtype) for _ in range(3))
     o, lse = pallasex.flash_attention_forward(q, k, v, causal=True)
-    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref_attn(q, k, v)), atol=2e-3)
+    ref = _ref_attn(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(o, np.float32), np.asarray(ref), atol=atol)
     assert lse.shape == (B, H, T)
 
 
+@pytest.mark.parametrize("dtype,atol", [(np.float32, 5e-3), (jnp.bfloat16, 1e-1)])
 @pytest.mark.parametrize("D", [64, 128])
-def test_flash_backward_matches_jax_vjp(rng, D):
+def test_flash_backward_matches_jax_vjp(rng, D, dtype, atol):
     B, H, T = 2, 2, 128
-    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) for _ in range(3))
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32), dtype) for _ in range(3))
     o, lse = pallasex.flash_attention_forward(q, k, v, causal=True)
-    do = jnp.asarray(rng.randn(*o.shape).astype(np.float32))
+    do = jnp.asarray(rng.randn(*o.shape).astype(np.float32), dtype)
     dq, dk, dv = pallasex.flash_attention_backward(q, k, v, o, lse, do, causal=True)
-    ref_grads = jax.vjp(lambda q, k, v: _ref_attn(q, k, v), q, k, v)[1](do)
+    f32 = jnp.float32
+    ref_grads = jax.vjp(lambda q, k, v: _ref_attn(q, k, v),
+                        q.astype(f32), k.astype(f32), v.astype(f32))[1](do.astype(f32))
     for got, want, name in zip((dq, dk, dv), ref_grads, "dq dk dv".split()):
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-3, err_msg=name)
+        np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
+                                   atol=atol, err_msg=name)
 
 
 def test_flash_noncausal(rng):
@@ -59,8 +67,11 @@ def test_checker_accepts_gpt2_shapes():
 
     q = FakeProxy((2, 12, 4096, 64))
     assert pallasex.flash_attention_supported(q, q, q, None, 0.0, True, None)
+    # T=1024 claims too (bf16-dot kernels beat the composite from T>=1024)
+    q_1k = FakeProxy((8, 12, 1024, 64))
+    assert pallasex.flash_attention_supported(q_1k, q_1k, q_1k, None, 0.0, True, None)
     # short sequences stay on the composite path (XLA wins on-chip, measured)
-    q_short = FakeProxy((8, 12, 1024, 64))
+    q_short = FakeProxy((8, 12, 512, 64))
     assert not pallasex.flash_attention_supported(q_short, q_short, q_short, None, 0.0, True, None)
     # unaligned sequence length stays on the composite path
     q_bad = FakeProxy((8, 12, 4100, 64))

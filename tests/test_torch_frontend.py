@@ -345,6 +345,17 @@ def test_masked_setitem_element_placement():
     out2 = tt.jit(Fill())(jnp.asarray(x.numpy()))
     np.testing.assert_allclose(np.asarray(out2), ref2.numpy())
 
+    # numel-1 multi-dim value broadcasts like a scalar (torch fill semantics)
+    class Fill1(torch.nn.Module):
+        def forward(self, x):
+            y = x.clone()
+            y[y > 0] = torch.full((1, 1), 5.0)
+            return y
+
+    ref1 = Fill1()(x)
+    out1 = tt.jit(Fill1())(jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(out1), ref1.numpy())
+
     # 2-D value: clear NotImplementedError, not a broadcast RuntimeError
     class Bad(torch.nn.Module):
         def forward(self, x, v):

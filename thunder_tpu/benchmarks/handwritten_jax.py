@@ -42,8 +42,10 @@ def init_params(cfg, seed: int = 0, dtype=jnp.float32) -> dict:
         return p
 
     params: dict[str, Any] = {
+        # N(0,1): the torch.nn.Embedding default, matching nn/module.py so
+        # both bench phases train the same model
         "wte.weight": jnp.asarray(
-            rng.randn(cfg.padded_vocab_size, cfg.n_embd) * 0.02, dtype),
+            rng.randn(cfg.padded_vocab_size, cfg.n_embd), dtype),
     }
     qkv_out = (cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size
     for i in range(cfg.n_layer):
@@ -78,6 +80,15 @@ def rope_cache(cfg, dtype=jnp.float32):
 # --------------------------------------------------------------------------
 # forward (bf16 compute, f32 norms/softmax/loss — same policy as autocast)
 # --------------------------------------------------------------------------
+
+
+def _library_flash_attention():
+    """jax's shipped TPU flash-attention kernel, if importable."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+        return flash_attention
+    except Exception:
+        return None
 
 
 def _norm_f(cfg, x, w, b, eps):
@@ -128,9 +139,19 @@ def forward(cfg, params, idx, targets, cos, sin, compute_dtype=jnp.bfloat16):
         if ng != nh:
             k = jnp.repeat(k, q_per_kv, axis=1)
             v = jnp.repeat(v, q_per_kv, axis=1)
-        # the attention a jax user writes today: the library's fused composite
-        # (falls back to manual softmax on jax versions without it)
-        if hasattr(jax.nn, "dot_product_attention"):
+        # the attention a jax user writes today, strongest available first:
+        # jax's library pallas flash kernel (the composite materializes
+        # B·H·T² probabilities for backward — OOM at llama-350m B=4 T=2048
+        # on one 16 GB chip), then the fused composite, then manual softmax
+        lib_flash = _library_flash_attention()
+        score_bytes = B * nh * T * T * 2
+        big_attention = T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
+        if lib_flash is not None and big_attention and T % 128 == 0 and hs >= 64:
+            y = lib_flash(q.astype(compute_dtype), k.astype(compute_dtype),
+                          v.astype(compute_dtype), causal=True,
+                          sm_scale=1.0 / math.sqrt(hs))
+            y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
+        elif hasattr(jax.nn, "dot_product_attention"):
             # rope promotes q/k to f32 (f32 cos/sin); the composite requires
             # uniform dtypes
             y = jax.nn.dot_product_attention(

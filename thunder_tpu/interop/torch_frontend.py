@@ -326,6 +326,10 @@ def _setitem_dispatch(args, kwargs):
     ukey = _unwrap(key)
     uval = _unwrap(value)
     if isinstance(ukey, TensorProxy) and ukey.dtype.is_bool:
+        if (isinstance(uval, TensorProxy) and uval.ndim >= 1
+                and int(np.prod(uval.shape)) == 1):
+            # numel-1 tensors broadcast like scalars in torch (fill semantics)
+            uval = ltorch.reshape(uval, ())
         if isinstance(uval, TensorProxy) and uval.ndim >= 1:
             # torch element placement: y[mask] = v with v a 1-D tensor of
             # mask.sum() elements assigned to the selected positions in

@@ -28,14 +28,24 @@ _LOW_PRECISION_IDS = {
     "torch.nn.functional.conv2d",
     "torch.nn.functional.conv1d",
     "torch.nn.functional.scaled_dot_product_attention",
+    # embedding: casting the weight makes the lookup emit the compute dtype,
+    # which keeps the whole transformer residual stream low-precision — the
+    # dominant saved-for-backward tensor class. An fp32 residual stream
+    # doubles activation memory and pushed llama-350m (B=4, T=2048) into
+    # XLA host-offload on one v5e chip (profiled: f32[4,2048,1024]
+    # copy-starts to S(1) at ~35 ms each).
+    "torch.nn.functional.embedding",
 }
-# composite ops forced to f32 compute (their decompositions stay f32)
+# composite ops forced to f32 compute (their decompositions stay f32).
+# cross_entropy is deliberately NOT here: its grad rule and the pallas kernel
+# both upcast per-block internally (bf16→f32 is exact, so the values are
+# identical), while a trace-level cast materializes the full (B*T, vocab)
+# logits in f32 — an extra 0.5 GB HBM round-trip per step on llama-350m.
 _F32_IDS = {
     "torch.nn.functional.layer_norm",
     "torch.nn.functional.rms_norm",
     "torch.softmax",
     "torch.log_softmax",
-    "torch.nn.functional.cross_entropy",
 }
 
 
