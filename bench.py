@@ -98,6 +98,11 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         from thunder_tpu.transforms.autocast import AutocastTransform
 
         transforms.append(AutocastTransform())
+    if os.environ.get("BENCH_FP8") == "1":
+        # delayed-scaling fp8 linears (fwd+bwd) on top of the bf16 policy
+        from thunder_tpu.transforms.fp8_training import FP8TrainingTransform
+
+        transforms.append(FP8TrainingTransform())
     step = TrainStep(tt.jit(model, transforms=transforms), optim.AdamW(lr=1e-4))
     rng = np.random.RandomState(0)
     idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)

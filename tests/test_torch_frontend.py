@@ -205,8 +205,15 @@ def test_torch_losses_and_unary_surface(rng):
 def test_unmapped_op_eager_fallback():
     """An op with no frontend mapping runs eagerly in torch on host instead of
     raising (the graph-split fallback role of reference dynamo/splitter.py:50);
-    gradients flow through it via torch.func.vjp."""
+    gradients flow through it via torch.func.vjp.
+
+    The lowered surface now covers every differentiable+meta-safe torch op we
+    know of, so the test temporarily unmaps torch.lerp to exercise the
+    machinery deterministically."""
     import warnings
+
+    from thunder_tpu.interop import torch_frontend as tf
+    from thunder_tpu.ops import auto_register as ar
 
     class Exotic(torch.nn.Module):
         def __init__(self):
@@ -215,25 +222,30 @@ def test_unmapped_op_eager_fallback():
 
         def forward(self, x):
             h = self.lin(x)
-            h = torch.linalg.solve_triangular(
-                h + 8 * torch.eye(8), torch.ones(8, 8), upper=False)  # no lowering registered
-            return h.sum()
+            return torch.lerp(h, torch.ones(8, 8), 0.25).sum()
 
-    m = Exotic()
-    x_t = torch.randn(4, 8, 8)
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        cm = tt.jit(m)
-        out = cm(jnp.asarray(x_t.numpy()))
-    assert any("solve_triangular" in str(x.message) for x in w)
-    x_ref = x_t.clone().requires_grad_(True)
-    ref = m(x_ref)
-    np.testing.assert_allclose(float(out), float(ref), atol=1e-4)
+    saved = ar._auto_symbols.pop("auto.lerp")
+    tf._eager_symbols.pop(torch.lerp, None)
+    tf._eager_warned.discard(torch.lerp)
+    try:
+        m = Exotic()
+        x_t = torch.randn(8, 8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            cm = tt.jit(m)
+            out = cm(jnp.asarray(x_t.numpy()))
+        assert any("lerp" in str(x.message) and "eagerly" in str(x.message) for x in w)
+        x_ref = x_t.clone().requires_grad_(True)
+        ref = m(x_ref)
+        np.testing.assert_allclose(float(out), float(ref), atol=1e-4)
 
-    ref.backward()
-    loss, grads = tt.value_and_grad(cm)(jnp.asarray(x_t.numpy()))
-    name = next(k for k in grads if k.endswith("lin.weight"))
-    np.testing.assert_allclose(np.asarray(grads[name]), m.lin.weight.grad.numpy(), atol=1e-3)
+        ref.backward()
+        loss, grads = tt.value_and_grad(cm)(jnp.asarray(x_t.numpy()))
+        name = next(k for k in grads if k.endswith("lin.weight"))
+        np.testing.assert_allclose(np.asarray(grads[name]), m.lin.weight.grad.numpy(), atol=1e-3)
+    finally:
+        ar._auto_symbols["auto.lerp"] = saved
+        tf._eager_symbols.pop(torch.lerp, None)
 
 
 def test_inplace_methods_functionalized():
@@ -386,3 +398,20 @@ def test_eager_fallback_int_dtype_with_x64_disabled():
         out = tt.jit(Buck())(jnp.asarray(x_np), jnp.asarray(b_np))
         got = np.asarray(out)
     np.testing.assert_array_equal(got, ref)
+
+
+def test_tensor_metadata_methods():
+    """Static metadata accessors (torch's auto-registered Tensor.* family)."""
+    import torch
+
+    class Meta(torch.nn.Module):
+        def forward(self, x):
+            assert x.ndimension() == 2 and x.nelement() == 6
+            assert x.element_size() == 4 and x.is_signed()
+            assert not x.is_conj() and x.is_contiguous()
+            assert x.is_same_size(x)
+            y = x.cpu().to_dense()
+            return y.sum() * x.dim()
+
+    out = tt.jit(Meta())(jnp.ones((2, 3), jnp.float32))
+    np.testing.assert_allclose(float(out), 12.0)

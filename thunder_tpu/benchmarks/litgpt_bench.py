@@ -95,6 +95,12 @@ def run(args) -> dict:
         dtype = jnp.float32
     else:
         dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    if getattr(args, "fp8", False):
+        # delayed-scaling fp8 linears (amax-history buffers, fwd+bwd);
+        # reference benchmark_litgpt.py TE fp8 role
+        from thunder_tpu.transforms.fp8_training import FP8TrainingTransform
+
+        transforms.append(FP8TrainingTransform())
     model = GPTForCausalLM(cfg, dtype=dtype)
     tm = tt.jit(model, transforms=transforms)
 
@@ -169,6 +175,8 @@ def main():
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--activation_checkpoint", action="store_true",
                    help="recompute each block in backward (remat.checkpoint)")
+    p.add_argument("--fp8", action="store_true",
+                   help="delayed-scaling fp8 linears (fwd+bwd)")
     p.add_argument("--autocast", action="store_true",
                    help="fp32 master weights + bf16 compute via AutocastTransform")
     p.add_argument("--distributed_mode", default="none",

@@ -146,12 +146,37 @@ def _register(*funcs):
 
 F = torch.nn.functional
 
-# --- metadata accessors handled inline ---
+# --- metadata accessors handled inline (static Python values at trace
+# time; the reference auto-registers these as opaque torch ops,
+# thunder/torch/default_torch_ops.py Tensor.* metadata family) ---
 _PASSTHROUGH_META = {
     torch.Tensor.size: lambda p, dim=None: tuple(p.shape) if dim is None else p.shape[dim],
     torch.Tensor.dim: lambda p: p.ndim,
     torch.Tensor.numel: lambda p: p.numel,
+    torch.Tensor.ndimension: lambda p: p.ndim,
+    torch.Tensor.nelement: lambda p: p.numel,
+    torch.Tensor.element_size: lambda p: p.dtype.bytes,
+    torch.Tensor.dim_order: lambda p: tuple(range(p.ndim)),
+    torch.Tensor.get_device: lambda p: -1,  # torch CPU convention; no CUDA here
+    torch.Tensor.is_signed: lambda p: p.dtype.is_signed,
+    torch.Tensor.is_conj: lambda p: False,
+    torch.Tensor.is_neg: lambda p: False,
+    torch.Tensor.is_inference: lambda p: False,
+    torch.Tensor.is_contiguous: lambda p, *a, **kw: True,
+    torch.Tensor.is_pinned: lambda p: False,
+    torch.Tensor.is_shared: lambda p: False,
+    torch.Tensor.is_coalesced: lambda p: True,
+    torch.Tensor.is_same_size: lambda p, other: tuple(p.shape) == tuple(
+        getattr(other, "proxy", other).shape),
+    torch.Tensor.retain_grad: lambda p: None,
+    torch.is_distributed: lambda p: False,
 }
+
+
+@_register(torch.Tensor.cpu, torch.Tensor.to_dense)
+def _placement_noop(x):
+    # functional backend: placement/densification is a no-op on proxies
+    return x
 
 
 @_register(F.linear)

@@ -1,0 +1,1282 @@
+"""Auto-catalog extension waves — closing the torch long tail toward the
+reference's ~700 auto-registered ops (thunder/torch/default_torch_ops.py:3).
+
+Every entry is a REAL torch-contract name (resolved by the frontend's
+qualified-name convention: plain ``<name>`` for ``torch.<name>`` /
+``Tensor.<name>`` / ``torch.nn.functional.<name>``, ``fft_<name>`` /
+``linalg_<name>`` / ``special_<name>`` for the submodule families) with
+torch argument order and semantics, lowered to jax. Shape rules come from
+``jax.eval_shape`` (auto_register.register_auto_op); gradients ride the
+generic jax.vjp fallback for the differentiable dict.
+
+Deliberately NOT registered (documented, like bincount): ops whose output
+shape depends on runtime values (nonzero, unique, masked_select — the
+torch interop frontend covers them via the host-eager fallback), sparse
+ops, RNG samplers (poisson/binomial: stateless tracing cannot reproduce
+torch's generator semantics), and fbgemm/quantized kernels.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# helpers (torch semantics on static shapes)
+# ---------------------------------------------------------------------------
+
+
+def _as_strided(a, size, stride, storage_offset=0):
+    """Gather-based as_strided over the flattened array (any strides)."""
+    flat = jnp.ravel(a)
+    idx = jnp.asarray(storage_offset, jnp.int32)
+    for d, (sz, st) in enumerate(zip(size, stride)):
+        shape = [1] * len(size)
+        shape[d] = sz
+        idx = idx + (jnp.arange(sz, dtype=jnp.int32) * st).reshape(shape)
+    return flat[idx]
+
+
+def _as_strided_scatter(a, src, size, stride, storage_offset=0):
+    flat = jnp.ravel(a)
+    idx = jnp.asarray(storage_offset, jnp.int32)
+    for d, (sz, st) in enumerate(zip(size, stride)):
+        shape = [1] * len(size)
+        shape[d] = sz
+        idx = idx + (jnp.arange(sz, dtype=jnp.int32) * st).reshape(shape)
+    return flat.at[jnp.ravel(idx)].set(jnp.ravel(src)).reshape(a.shape)
+
+
+def _sum_to_size(a, *size):
+    if len(size) == 1 and isinstance(size[0], (tuple, list)):
+        size = tuple(size[0])
+    lead = a.ndim - len(size)
+    out = jnp.sum(a, axis=tuple(range(lead))) if lead > 0 else a
+    axes = tuple(i for i, s in enumerate(size) if s == 1 and out.shape[i] != 1)
+    if axes:
+        out = jnp.sum(out, axis=axes, keepdims=True)
+    return out
+
+
+def _masked_scatter(a, mask, source):
+    mask_b = jnp.broadcast_to(mask, a.shape)
+    flat_m = jnp.ravel(mask_b)
+    pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+    src = jnp.ravel(source)
+    pos = jnp.clip(pos, 0, max(src.shape[0] - 1, 0))
+    return jnp.where(flat_m, src[pos], jnp.ravel(a)).reshape(a.shape)
+
+
+def _index_fill(a, dim, index, value):
+    moved = jnp.moveaxis(a, dim, 0)
+    out = moved.at[index].set(value)
+    return jnp.moveaxis(out, 0, dim)
+
+
+def _scatter_nd_along(a, dim, index, src, mode, include_self=True):
+    """scatter/scatter_reduce along dim: index has src's shape (torch)."""
+    moved = jnp.moveaxis(a, dim, -1)
+    idx = jnp.moveaxis(index, dim, -1)
+    s = jnp.moveaxis(src, dim, -1) if hasattr(src, "ndim") and getattr(src, "ndim", 0) else src
+    lead = moved.shape[:-1]
+    R = int(np.prod(lead)) if lead else 1
+    flat = moved.reshape(R, moved.shape[-1])
+    idx2 = idx.reshape(R, idx.shape[-1])
+    rows = jnp.arange(R, dtype=jnp.int32)[:, None]
+    if hasattr(s, "ndim") and getattr(s, "ndim", 0):
+        s2 = s.reshape(R, s.shape[-1]).astype(flat.dtype)
+    else:
+        s2 = jnp.full(idx2.shape, s, flat.dtype)
+    if mode == "set":
+        out = flat.at[rows, idx2].set(s2)
+    elif mode == "sum":
+        base = flat if include_self else flat.at[rows, idx2].set(0.0)
+        out = base.at[rows, idx2].add(s2)
+    elif mode == "prod":
+        base = flat if include_self else flat.at[rows, idx2].set(1.0)
+        out = base.at[rows, idx2].multiply(s2)
+    elif mode == "amax":
+        base = flat if include_self else flat.at[rows, idx2].set(-jnp.inf)
+        out = base.at[rows, idx2].max(s2)
+    elif mode == "amin":
+        base = flat if include_self else flat.at[rows, idx2].set(jnp.inf)
+        out = base.at[rows, idx2].min(s2)
+    elif mode == "mean":
+        ssum = (flat if include_self else flat.at[rows, idx2].set(0.0)).at[rows, idx2].add(s2)
+        ones = jnp.ones_like(s2)
+        cnt = (jnp.ones_like(flat) if include_self
+               else jnp.ones_like(flat).at[rows, idx2].set(0.0)).at[rows, idx2].add(ones)
+        out = ssum / cnt
+    else:
+        raise NotImplementedError(f"scatter_reduce mode {mode!r}")
+    return jnp.moveaxis(out.reshape(*lead, moved.shape[-1]), -1, dim)
+
+
+def _combinations(a, r=2, with_replacement=False):
+    n = a.shape[0]
+    gen = itertools.combinations_with_replacement if with_replacement else itertools.combinations
+    idx = np.array(list(gen(range(n), r)), np.int32).reshape(-1, r)
+    return a[jnp.asarray(idx)]
+
+
+def _cartesian_prod(*ts):
+    grids = jnp.meshgrid(*ts, indexing="ij")
+    stacked = jnp.stack([g.ravel() for g in grids], axis=-1)
+    return stacked[:, 0] if len(ts) == 1 else stacked
+
+
+def _constant_pad_nd(a, pad, value=0.0):
+    # torch pad format: last dim first, (left, right) pairs
+    cfg = [(0, 0)] * a.ndim
+    for i in range(len(pad) // 2):
+        cfg[a.ndim - 1 - i] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    return jnp.pad(a, cfg, constant_values=value)
+
+
+def _conv_tbc(a, weight, bias, pad=0):
+    # a (T, B, C_in), weight (K, C_in, C_out) -> (T_out, B, C_out)
+    x = jnp.transpose(a, (1, 2, 0))  # (B, C_in, T)
+    w = jnp.transpose(weight, (2, 1, 0))  # (C_out, C_in, K)
+    out = jax.lax.conv_general_dilated(x, w, (1,), [(int(pad), int(pad))],
+                                       dimension_numbers=("NCH", "OIH", "NCH"))
+    return jnp.transpose(out, (2, 0, 1)) + bias
+
+
+def _norm_except_dim(v, pow=2, dim=0):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sum(jnp.abs(v) ** pow, axis=axes, keepdims=True) ** (1.0 / pow)
+
+
+def _unravel_index(indices, shape):
+    return tuple(jnp.unravel_index(indices, tuple(shape)))  # torch returns a tuple
+
+
+def _lu_pieces(a):
+    import jax.scipy.linalg as jsl
+
+    p, l, u = jsl.lu(a)
+    return p, l, u
+
+
+def _lu_factor(a):
+    import jax.scipy.linalg as jsl
+
+    lu, piv = jsl.lu_factor(a)
+    return lu, piv.astype(jnp.int32) + 1  # torch pivots are 1-based
+
+
+def _lu_solve(b, lu_data, lu_pivots):
+    import jax.scipy.linalg as jsl
+
+    return jsl.lu_solve((lu_data, lu_pivots.astype(jnp.int32) - 1), b)
+
+
+def _lu_unpack(lu_data, lu_pivots, unpack_data=True, unpack_pivots=True):
+    m, n = lu_data.shape[-2:]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    piv = lu_pivots.astype(jnp.int32) - 1
+
+    def swap_seq(piv1d):
+        def body(i, p):
+            j = piv1d[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        return jax.lax.fori_loop(0, piv1d.shape[0], body, jnp.arange(m, dtype=jnp.int32))
+
+    if lu_pivots.ndim == 1:
+        perm = swap_seq(piv)
+        P = jnp.eye(m, dtype=lu_data.dtype)[:, perm]
+    else:
+        flat = piv.reshape(-1, piv.shape[-1])
+        perms = jax.vmap(swap_seq)(flat)
+        P = jax.vmap(lambda p: jnp.eye(m, dtype=lu_data.dtype)[:, p])(perms)
+        P = P.reshape(piv.shape[:-1] + (m, m))
+    return P, L, U
+
+
+def _solve_triangular(a, b, upper=True, left=True, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    # torch broadcasts batch dims; jax requires them to match
+    bshape = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+    a = jnp.broadcast_to(a, bshape + a.shape[-2:])
+    b = jnp.broadcast_to(b, bshape + b.shape[-2:])
+    if not left:  # solve X·A = B  via  Aᵀ·Xᵀ = Bᵀ
+        out = jsl.solve_triangular(jnp.swapaxes(a, -2, -1), jnp.swapaxes(b, -2, -1),
+                                   lower=upper, unit_diagonal=unitriangular)
+        return jnp.swapaxes(out, -2, -1)
+    return jsl.solve_triangular(a, b, lower=not upper, unit_diagonal=unitriangular)
+
+
+def _tensorinv(a, ind=2):
+    lead = a.shape[:ind]
+    n = int(np.prod(a.shape[ind:]))
+    inv = jnp.linalg.inv(a.reshape(int(np.prod(lead)), n))
+    return inv.reshape(a.shape[ind:] + lead)
+
+
+def _poly_recurrence(x, n, init0, init1, rec):
+    """Orthogonal-polynomial families via their 3-term recurrence (static n)."""
+    n = int(n)
+    if n == 0:
+        return jnp.broadcast_to(jnp.asarray(init0, x.dtype), x.shape) * jnp.ones_like(x)
+    pm1 = jnp.ones_like(x) * init0
+    p = init1(x)
+    for k in range(1, n):
+        pm1, p = p, rec(k, x, p, pm1)
+    return p
+
+
+def chebyshev_t(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: x, lambda k, x, p, pm1: 2 * x * p - pm1)
+
+
+def chebyshev_u(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: 2 * x, lambda k, x, p, pm1: 2 * x * p - pm1)
+
+
+def chebyshev_v(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: 2 * x - 1, lambda k, x, p, pm1: 2 * x * p - pm1)
+
+
+def chebyshev_w(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: 2 * x + 1, lambda k, x, p, pm1: 2 * x * p - pm1)
+
+
+def hermite_h(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: 2 * x,
+                            lambda k, x, p, pm1: 2 * x * p - 2 * k * pm1)
+
+
+def hermite_he(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: x,
+                            lambda k, x, p, pm1: x * p - k * pm1)
+
+
+def laguerre_l(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: 1 - x,
+                            lambda k, x, p, pm1: ((2 * k + 1 - x) * p - k * pm1) / (k + 1))
+
+
+def legendre_p(x, n):
+    return _poly_recurrence(x, n, 1.0, lambda x: x,
+                            lambda k, x, p, pm1: ((2 * k + 1) * x * p - k * pm1) / (k + 1))
+
+
+def _bessel_k0(x):
+    """A&S 9.8.5/9.8.6 polynomial approximations (differentiable)."""
+    x = jnp.asarray(x, jnp.float32) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer) else x
+    small = x <= 2.0
+    xs = jnp.where(small, x, 2.0)
+    t = (xs / 2.0) ** 2
+    i0 = jax.scipy.special.i0(xs)
+    k0_small = (-jnp.log(xs / 2.0) * i0 - 0.57721566
+                + t * (0.42278420 + t * (0.23069756 + t * (0.03488590
+                + t * (0.00262698 + t * (0.00010750 + t * 0.00000740))))))
+    xl = jnp.where(small, 2.0, x)
+    u = 2.0 / xl
+    k0_large = (jnp.exp(-xl) / jnp.sqrt(xl)) * (1.25331414 + u * (-0.07832358
+                + u * (0.02189568 + u * (-0.01062446 + u * (0.00587872
+                + u * (-0.00251540 + u * 0.00053208))))))
+    return jnp.where(small, k0_small, k0_large)
+
+
+def _bessel_k1(x):
+    small = x <= 2.0
+    xs = jnp.where(small, x, 2.0)
+    t = (xs / 2.0) ** 2
+    i1 = jax.scipy.special.i1(xs)
+    k1_small = (jnp.log(xs / 2.0) * i1 + (1.0 / xs) * (1.0
+                + t * (0.15443144 + t * (-0.67278579 + t * (-0.18156897
+                + t * (-0.01919402 + t * (-0.00110404 + t * (-0.00004686))))))))
+    xl = jnp.where(small, 2.0, x)
+    u = 2.0 / xl
+    k1_large = (jnp.exp(-xl) / jnp.sqrt(xl)) * (1.25331414 + u * (0.23498619
+                + u * (-0.03655620 + u * (0.01504268 + u * (-0.00780353
+                + u * (0.00325614 + u * (-0.00068245)))))))
+    return jnp.where(small, k1_small, k1_large)
+
+
+def _bessel_j0(x):
+    """J0 via the standard rational/asymptotic split (jax's bessel_jn
+    backward recurrence NaNs in f32)."""
+    ax = jnp.abs(x)
+    xs = jnp.where(ax <= 8.0, ax, 8.0)
+    y = xs * xs
+    num = (57568490574.0 + y * (-13362590354.0 + y * (651619640.7
+           + y * (-11214424.18 + y * (77392.33017 + y * (-184.9052456))))))
+    den = (57568490411.0 + y * (1029532985.0 + y * (9494680.718
+           + y * (59272.64853 + y * (267.8532712 + y)))))
+    small = num / den
+    axl = jnp.where(ax <= 8.0, 8.0, ax)
+    z = 8.0 / axl
+    y2 = z * z
+    xx = axl - 0.785398164
+    p0 = (1.0 + y2 * (-0.1098628627e-2 + y2 * (0.2734510407e-4
+          + y2 * (-0.2073370639e-5 + y2 * 0.2093887211e-6))))
+    q0 = (-0.1562499995e-1 + y2 * (0.1430488765e-3 + y2 * (-0.6911147651e-5
+          + y2 * (0.7621095161e-6 + y2 * (-0.934935152e-7)))))
+    large = jnp.sqrt(0.636619772 / axl) * (jnp.cos(xx) * p0 - z * jnp.sin(xx) * q0)
+    return jnp.where(ax <= 8.0, small, large)
+
+
+def _bessel_j1(x):
+    ax = jnp.abs(x)
+    xs = jnp.where(ax <= 8.0, ax, 8.0)
+    y = xs * xs
+    num = xs * (72362614232.0 + y * (-7895059235.0 + y * (242396853.1
+          + y * (-2972611.439 + y * (15704.48260 + y * (-30.16036606))))))
+    den = (144725228442.0 + y * (2300535178.0 + y * (18583304.74
+          + y * (99447.43394 + y * (376.9991397 + y)))))
+    small = num / den
+    axl = jnp.where(ax <= 8.0, 8.0, ax)
+    z = 8.0 / axl
+    y2 = z * z
+    xx = axl - 2.356194491
+    p1 = (1.0 + y2 * (0.183105e-2 + y2 * (-0.3516396496e-4
+          + y2 * (0.2457520174e-5 + y2 * (-0.240337019e-6)))))
+    q1 = (0.04687499995 + y2 * (-0.2002690873e-3 + y2 * (0.8449199096e-5
+          + y2 * (-0.88228987e-6 + y2 * 0.105787412e-6))))
+    large = jnp.sqrt(0.636619772 / axl) * (jnp.cos(xx) * p1 - z * jnp.sin(xx) * q1)
+    return jnp.sign(x) * jnp.where(ax <= 8.0, small, large)
+
+
+def _bessel_j(x, v):
+    return _bessel_j0(x) if v == 0 else _bessel_j1(x)
+
+
+def _adaptive_pool_slices(in_size: int, out_size: int):
+    """torch adaptive pooling window boundaries (static)."""
+    return [(int(math.floor(i * in_size / out_size)),
+             int(math.ceil((i + 1) * in_size / out_size))) for i in range(out_size)]
+
+
+def _adaptive_avg_pool1d(a, output_size):
+    out_size = output_size[0] if isinstance(output_size, (tuple, list)) else int(output_size)
+    L = a.shape[-1]
+    cols = [jnp.mean(a[..., s:e], axis=-1) for s, e in _adaptive_pool_slices(L, out_size)]
+    return jnp.stack(cols, axis=-1)
+
+
+def _adaptive_max_pool1d(a, output_size, return_indices=False):
+    out_size = output_size[0] if isinstance(output_size, (tuple, list)) else int(output_size)
+    L = a.shape[-1]
+    vals, idxs = [], []
+    for s, e in _adaptive_pool_slices(L, out_size):
+        win = a[..., s:e]
+        vals.append(jnp.max(win, axis=-1))
+        idxs.append(jnp.argmax(win, axis=-1) + s)
+    v = jnp.stack(vals, -1)
+    if return_indices:
+        return v, jnp.stack(idxs, -1).astype(jnp.int32)
+    return v
+
+
+def _adaptive_avg_pool3d(a, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    D, H, W = a.shape[-3:]
+    od, oh, ow = (int(o) if o is not None else s for o, s in zip(output_size, (D, H, W)))
+    planes = []
+    for sd, ed in _adaptive_pool_slices(D, od):
+        rows = []
+        for sh, eh in _adaptive_pool_slices(H, oh):
+            cols = [jnp.mean(a[..., sd:ed, sh:eh, sw:ew], axis=(-3, -2, -1))
+                    for sw, ew in _adaptive_pool_slices(W, ow)]
+            rows.append(jnp.stack(cols, -1))
+        planes.append(jnp.stack(rows, -2))
+    return jnp.stack(planes, -3)
+
+
+def _adaptive_max_pool3d(a, output_size, return_indices=False):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    D, H, W = a.shape[-3:]
+    od, oh, ow = (int(o) if o is not None else s for o, s in zip(output_size, (D, H, W)))
+    planes = []
+    for sd, ed in _adaptive_pool_slices(D, od):
+        rows = []
+        for sh, eh in _adaptive_pool_slices(H, oh):
+            cols = [jnp.max(a[..., sd:ed, sh:eh, sw:ew], axis=(-3, -2, -1))
+                    for sw, ew in _adaptive_pool_slices(W, ow)]
+            rows.append(jnp.stack(cols, -1))
+        planes.append(jnp.stack(rows, -2))
+    out = jnp.stack(planes, -3)
+    if return_indices:
+        raise NotImplementedError("adaptive_max_pool3d with indices is not supported")
+    return out
+
+
+def _windowed_extrema_pool(a, ndims, kernel_size, stride=None, padding=0, return_indices=False,
+                           dilation=1, ceil_mode=False):
+    """max_pool{1,2,3}d_with_indices via static window extraction."""
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode pooling is not supported in the auto catalog")
+    ks = (kernel_size,) * ndims if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None or stride == [] else (
+        (stride,) * ndims if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * ndims if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * ndims if isinstance(dilation, int) else tuple(dilation)
+    neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+    cfg = [(0, 0)] * (a.ndim - ndims) + [(p, p) for p in pd]
+    ap = jnp.pad(a, cfg, constant_values=neg)
+    spatial = ap.shape[-ndims:]
+    out_sizes = [(spatial[d] - dl[d] * (ks[d] - 1) - 1) // st[d] + 1 for d in range(ndims)]
+    # windows: gather one slice per kernel offset (static python loop)
+    wins, flat_off = [], []
+    for off in itertools.product(*[range(k) for k in ks]):
+        sl = [slice(None)] * (a.ndim - ndims)
+        for d in range(ndims):
+            start = off[d] * dl[d]
+            sl.append(slice(start, start + st[d] * (out_sizes[d] - 1) + 1, st[d]))
+        wins.append(ap[tuple(sl)])
+        flat_off.append(off)
+    stack = jnp.stack(wins, axis=0)
+    arg = jnp.argmax(stack, axis=0)
+    val = jnp.max(stack, axis=0)
+    if not return_indices:
+        return val
+    # recover flat input indices (torch contract: index into the UNpadded input)
+    offsets = jnp.asarray(np.array(flat_off, np.int32))  # (n_windows, ndims)
+    grids = jnp.meshgrid(*[jnp.arange(o) * s for o, s in zip(out_sizes, st)], indexing="ij")
+    pos = [offsets[:, d][arg] * dl[d] + grids[d] - pd[d] for d in range(ndims)]
+    in_spatial = a.shape[-ndims:]
+    flat = pos[0]
+    for d in range(1, ndims):
+        flat = flat * in_spatial[d] + pos[d]
+    return val, flat.astype(jnp.int64 if False else jnp.int32)
+
+
+def _max_unpool(a, indices, ndims, kernel_size, stride=None, padding=0, output_size=None):
+    if output_size is None:
+        ks = (kernel_size,) * ndims if isinstance(kernel_size, int) else tuple(kernel_size)
+        st = ks if stride is None or stride == [] else (
+            (stride,) * ndims if isinstance(stride, int) else tuple(stride))
+        pd = (padding,) * ndims if isinstance(padding, int) else tuple(padding)
+        out_spatial = [(a.shape[-ndims + d] - 1) * st[d] - 2 * pd[d] + ks[d] for d in range(ndims)]
+    else:
+        out_spatial = [int(s) for s in tuple(output_size)[-ndims:]]
+    lead = a.shape[:-ndims]
+    n = int(np.prod(out_spatial))
+    flat_in = a.reshape(lead + (-1,))
+    flat_idx = indices.reshape(lead + (-1,)).astype(jnp.int32)
+    out = jnp.zeros(lead + (n,), a.dtype)
+    R = int(np.prod(lead)) if lead else 1
+    o2 = out.reshape(R, n)
+    i2 = flat_idx.reshape(R, -1)
+    v2 = flat_in.reshape(R, -1)
+    o2 = o2.at[jnp.arange(R, dtype=jnp.int32)[:, None], i2].set(v2)
+    return o2.reshape(lead + tuple(out_spatial))
+
+
+def _lp_pool(a, ndims, norm_type, kernel_size, stride=None, ceil_mode=False):
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode lp_pool is not supported")
+    ks = (kernel_size,) * ndims if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * ndims if isinstance(stride, int) else tuple(stride))
+    p = float(norm_type)
+    powed = jnp.abs(a) ** p
+    window = (1,) * (a.ndim - ndims) + ks
+    strides = (1,) * (a.ndim - ndims) + st
+    summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, window, strides, "VALID")
+    return summed ** (1.0 / p)
+
+
+def _pdist(a, p=2.0):
+    n = a.shape[0]
+    iu = np.triu_indices(n, 1)
+    diff = a[jnp.asarray(iu[0])] - a[jnp.asarray(iu[1])]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+
+def _bilinear(x1, x2, weight, bias=None):
+    out = jnp.einsum("...i,oij,...j->...o", x1, weight, x2)
+    return out if bias is None else out + bias
+
+
+def _ctc_loss(log_probs, targets, input_lengths, target_lengths, blank=0,
+              reduction="mean", zero_infinity=False):
+    """torch F.ctc_loss((T,N,C) log_probs) via optax.ctc_loss ((N,T,C))."""
+    import optax
+
+    lp = jnp.transpose(log_probs, (1, 0, 2))  # (N, T, C)
+    N, T, C = lp.shape
+    S = targets.shape[-1] if targets.ndim == 2 else int(targets.shape[0])
+    tg = targets if targets.ndim == 2 else targets.reshape(N, -1)
+    t_arange = jnp.arange(T)[None, :]
+    s_arange = jnp.arange(tg.shape[1])[None, :]
+    logit_pad = (t_arange >= jnp.asarray(input_lengths).reshape(N, 1)).astype(lp.dtype)
+    label_pad = (s_arange >= jnp.asarray(target_lengths).reshape(N, 1)).astype(lp.dtype)
+    per_seq = optax.ctc_loss(lp, logit_pad, tg, label_pad, blank_id=blank)
+    if zero_infinity:
+        per_seq = jnp.where(jnp.isfinite(per_seq), per_seq, 0.0)
+    if reduction == "mean":
+        # torch divides each sequence loss by its target length before averaging
+        return jnp.mean(per_seq / jnp.maximum(jnp.asarray(target_lengths, per_seq.dtype), 1.0))
+    if reduction == "sum":
+        return jnp.sum(per_seq)
+    return per_seq
+
+
+def _grid_sample(a, grid, mode="bilinear", padding_mode="zeros", align_corners=False):
+    """2-D grid_sample, NCHW input + NHW2 grid (torch contract subset)."""
+    if a.ndim != 4 or grid.ndim != 4:
+        raise NotImplementedError("grid_sample supports 4-D input (NCHW) only")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(f"grid_sample padding_mode={padding_mode!r}")
+    N, C, H, W = a.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    gx = unnorm(grid[..., 0], W)
+    gy = unnorm(grid[..., 1], H)
+
+    def sample(iy, ix):
+        inside = (iy >= 0) & (iy < H) & (ix >= 0) & (ix < W)
+        iyc = jnp.clip(iy, 0, H - 1)
+        ixc = jnp.clip(ix, 0, W - 1)
+        v = a[jnp.arange(N)[:, None, None], :, iyc, ixc]  # (N, Ho, Wo, C)
+        if padding_mode == "zeros":
+            v = jnp.where(inside[..., None], v, 0.0)
+        return v
+
+    if mode == "nearest":
+        out = sample(jnp.round(gy).astype(jnp.int32), jnp.round(gx).astype(jnp.int32))
+    elif mode == "bilinear":
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (gx - x0)[..., None]
+        wy = (gy - y0)[..., None]
+        out = (sample(y0, x0) * (1 - wy) * (1 - wx) + sample(y0, x1) * (1 - wy) * wx
+               + sample(y1, x0) * wy * (1 - wx) + sample(y1, x1) * wy * wx)
+    else:
+        raise NotImplementedError(f"grid_sample mode={mode!r}")
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def _affine_grid(theta, size, align_corners=False):
+    N, C, H, W = size
+
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # (H, W, 3)
+    return jnp.einsum("hwk,nik->nhwi", base, theta)
+
+
+def _gru_cell(x, hx, w_ih, w_hh, b_ih=None, b_hh=None):
+    gi = x @ w_ih.T + (0 if b_ih is None else b_ih)
+    gh = hx @ w_hh.T + (0 if b_hh is None else b_hh)
+    H = hx.shape[-1]
+    ir, iz, in_ = gi[..., :H], gi[..., H:2 * H], gi[..., 2 * H:]
+    hr, hz, hn = gh[..., :H], gh[..., H:2 * H], gh[..., 2 * H:]
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return n + z * (hx - n)
+
+
+def _lstm_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    hx, cx = hidden
+    g = x @ w_ih.T + hx @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    H = hx.shape[-1]
+    i = jax.nn.sigmoid(g[..., :H])
+    f = jax.nn.sigmoid(g[..., H:2 * H])
+    c_t = jnp.tanh(g[..., 2 * H:3 * H])
+    o = jax.nn.sigmoid(g[..., 3 * H:])
+    c = f * cx + i * c_t
+    return o * jnp.tanh(c), c
+
+
+def _rnn_cell(x, hx, w_ih, w_hh, b_ih=None, b_hh=None, fn=jnp.tanh):
+    g = x @ w_ih.T + hx @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    return fn(g)
+
+
+def _stft(a, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          pad_mode="reflect", normalized=False, onesided=True, return_complex=True):
+    if not return_complex:
+        raise NotImplementedError("stft with return_complex=False is not supported")
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = jnp.ones(wl) if window is None else window
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+    x = a if a.ndim == 2 else a[None]
+    if center:
+        x = jnp.pad(x, ((0, 0), (n_fft // 2, n_fft // 2)),
+                    mode="reflect" if pad_mode == "reflect" else "constant")
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop
+    starts = np.arange(n_frames) * hop
+    frames = jnp.stack([x[:, s:s + n_fft] for s in starts], 1) * win  # (B, F, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+    spec = jnp.swapaxes(spec, 1, 2)  # (B, freq, frames)
+    if normalized:
+        spec = spec / math.sqrt(n_fft)  # torch: frame_length**-0.5
+    return spec if a.ndim == 2 else spec[0]
+
+
+def _istft(spec, n_fft, hop_length=None, win_length=None, window=None, center=True,
+           normalized=False, onesided=True, length=None, return_complex=False):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = jnp.ones(wl) if window is None else window
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+    x = spec if spec.ndim == 3 else spec[None]
+    if normalized:
+        x = x * math.sqrt(n_fft)  # inverse of torch's frame_length**-0.5
+    frames = jnp.fft.irfft(jnp.swapaxes(x, 1, 2), n=n_fft, axis=-1) if onesided \
+        else jnp.real(jnp.fft.ifft(jnp.swapaxes(x, 1, 2), axis=-1))
+    frames = frames * win
+    n_frames = frames.shape[1]
+    T = n_fft + hop * (n_frames - 1)
+    out = jnp.zeros((frames.shape[0], T), frames.dtype)
+    wsum = jnp.zeros((T,), frames.dtype)
+    for i in range(n_frames):
+        out = out.at[:, i * hop:i * hop + n_fft].add(frames[:, i])
+        wsum = wsum.at[i * hop:i * hop + n_fft].add(win ** 2)
+    out = out / jnp.maximum(wsum, 1e-11)
+    if center:
+        out = out[:, n_fft // 2: T - n_fft // 2]
+    if length is not None:
+        out = out[:, :length]
+    return out if spec.ndim == 3 else out[0]
+
+
+def _batch_norm_stats(a, eps):
+    axes = (0,) + tuple(range(2, a.ndim))
+    mean = jnp.mean(a, axes)
+    var = jnp.var(a, axes)
+    return mean, jax.lax.rsqrt(var + eps)
+
+
+def _native_layer_norm(a, normalized_shape, weight, bias, eps):
+    nd = len(tuple(normalized_shape))
+    axes = tuple(range(a.ndim - nd, a.ndim))
+    mean = jnp.mean(a, axes, keepdims=True)
+    var = jnp.var(a, axes, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    out = (a - mean) * rstd
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out, mean, rstd
+
+
+def _native_group_norm(a, weight, bias, N, C, HxW, group, eps):
+    x = a.reshape(N, group, C // group, -1)
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    out = ((x - mean) * rstd).reshape(a.shape)
+    if weight is not None:
+        out = out * weight.reshape(1, C, *([1] * (a.ndim - 2)))
+    if bias is not None:
+        out = out + bias.reshape(1, C, *([1] * (a.ndim - 2)))
+    return out, mean.reshape(N, group), rstd.reshape(N, group)
+
+
+# ---------------------------------------------------------------------------
+# wave 6 — differentiable long tail (real torch-contract names)
+# ---------------------------------------------------------------------------
+
+EXT_DIFF: dict[str, Callable] = {
+    # ---- dtype-cast Tensor methods (Tensor.bfloat16() etc.) ----
+    "bfloat16": lambda a: a.astype(jnp.bfloat16),
+    "half": lambda a: a.astype(jnp.float16),
+    "double": lambda a: a.astype(jnp.float64),
+    "cfloat": lambda a: a.astype(jnp.complex64),
+    "cdouble": lambda a: a.astype(jnp.complex128),
+    "chalf": lambda a: a.astype(jnp.complex64),  # jax has no complex32
+    # ---- comparison/elementwise aliases ----
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less": jnp.less,
+    "less_equal": jnp.less_equal,
+    "not_equal": jnp.not_equal,
+    "clip": lambda a, min=None, max=None: jnp.clip(a, min, max),
+    "sgn": lambda a: jnp.where(a == 0, 0, a / jnp.abs(a)) if jnp.iscomplexobj(a) else jnp.sign(a),
+    "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside,
+    "logaddexp": jnp.logaddexp,
+    "logaddexp2": jnp.logaddexp2,
+    "rsub": lambda a, b, alpha=1.0: b - alpha * a,
+    "trapz": lambda y, x=None, dim=-1: jnp.trapezoid(y, x, axis=dim),
+    "frac": lambda a: a - jnp.trunc(a),
+    "nanmean": lambda a, dim=None, keepdim=False: jnp.nanmean(a, axis=dim, keepdims=keepdim),
+    "nansum": lambda a, dim=None, keepdim=False: jnp.nansum(a, axis=dim, keepdims=keepdim),
+    "aminmax": lambda a, dim=None, keepdim=False: (
+        jnp.min(a, axis=dim, keepdims=keepdim), jnp.max(a, axis=dim, keepdims=keepdim)),
+    "dist": lambda a, b, p=2.0: jnp.sum(jnp.abs(a - b) ** p) ** (1.0 / p),
+    "absolute": jnp.abs,
+    "negative": jnp.negative,
+    "swapaxes": lambda a, d0, d1: jnp.swapaxes(a, d0, d1),
+    "ravel": jnp.ravel,
+    "cummax": lambda a, dim: (jax.lax.cummax(a, axis=dim),
+                              _cummax_indices(a, dim)),
+    "cumprod": lambda a, dim, dtype=None: jnp.cumprod(
+        a if dtype is None else a.astype(dtype), axis=dim),
+    "median": lambda a, dim=None, keepdim=False: _median(a, dim, keepdim),
+    # ---- linear algebra long tail ----
+    "dot": jnp.dot,
+    "vdot": jnp.vdot,
+    "mv": jnp.matmul,
+    "tensordot": lambda a, b, dims=2: jnp.tensordot(a, b, axes=dims),
+    "kron": jnp.kron,
+    "chain_matmul": lambda *ms: jnp.linalg.multi_dot(ms),
+    "matrix_power": jnp.linalg.matrix_power,
+    "pinverse": jnp.linalg.pinv,
+    "inverse": jnp.linalg.inv,
+    "logdet": lambda a: jnp.linalg.slogdet(a)[1],
+    "det": jnp.linalg.det,
+    "slogdet": jnp.linalg.slogdet,
+    "cholesky": lambda a, upper=False: jnp.swapaxes(jnp.conjugate(jnp.linalg.cholesky(a)), -2, -1)
+        if upper else jnp.linalg.cholesky(a),
+    "qr": lambda a, some=True: jnp.linalg.qr(a, mode="reduced" if some else "complete"),
+    # torch.svd returns V (a == U @ diag(S) @ V^H), jax returns Vh
+    "svd": lambda a, some=True, compute_uv=True: _torch_svd(a, some)
+        if compute_uv else jnp.linalg.svd(a, compute_uv=False),
+    "frobenius_norm": lambda a, dim=None, keepdim=False: jnp.sqrt(
+        jnp.sum(a * a, axis=tuple(dim) if isinstance(dim, (list, tuple)) else dim,
+                keepdims=keepdim)),
+    "nuclear_norm": lambda a, keepdim=False: jnp.sum(jnp.linalg.svd(a, compute_uv=False)),
+    "norm_except_dim": _norm_except_dim,
+    "linalg_cholesky_ex": lambda a, upper=False, check_errors=False: (
+        jnp.linalg.cholesky(a), jnp.zeros(a.shape[:-2], jnp.int32)),
+    "linalg_inv_ex": lambda a, check_errors=False: (
+        jnp.linalg.inv(a), jnp.zeros(a.shape[:-2], jnp.int32)),
+    "linalg_solve_ex": lambda a, b, left=True, check_errors=False: (
+        jnp.linalg.solve(a, b) if left else jnp.swapaxes(
+            jnp.linalg.solve(jnp.swapaxes(a, -2, -1), jnp.swapaxes(b, -2, -1)), -2, -1),
+        jnp.zeros(a.shape[:-2], jnp.int32)),
+    "linalg_lu": lambda a, pivot=True: _lu_pieces(a),
+    "linalg_lu_factor": _lu_factor,
+    "linalg_lu_factor_ex": lambda a, pivot=True, check_errors=False: (
+        *_lu_factor(a), jnp.zeros(a.shape[:-2], jnp.int32)),
+    "linalg_lu_solve": lambda lu, piv, b, left=True, adjoint=False: _lu_solve(b, lu, piv),
+    "lu_solve": _lu_solve,  # torch.lu_solve(b, LU_data, LU_pivots)
+    "lu_unpack": _lu_unpack,
+    "linalg_solve_triangular": lambda a, b, upper=True, left=True, unitriangular=False:
+        _solve_triangular(a, b, upper, left, unitriangular),
+    "linalg_tensorinv": _tensorinv,
+    "linalg_eig": jnp.linalg.eig,
+    "linalg_eigvals": jnp.linalg.eigvals,
+    "matrix_exp_": jax.scipy.linalg.expm,
+    # ---- fft remainder ----
+    "fft_hfft": lambda a, n=None, dim=-1, norm=None: jnp.fft.hfft(a, n=n, axis=dim, norm=norm),
+    "fft_ihfft": lambda a, n=None, dim=-1, norm=None: jnp.fft.ihfft(a, n=n, axis=dim, norm=norm),
+    "fft_rfftn": lambda a, s=None, dim=None, norm=None: jnp.fft.rfftn(a, s=s, axes=dim, norm=norm),
+    "fft_irfftn": lambda a, s=None, dim=None, norm=None: jnp.fft.irfftn(a, s=s, axes=dim, norm=norm),
+    "fft_fftfreq": lambda n, d=1.0: jnp.fft.fftfreq(n, d),
+    "fft_rfftfreq": lambda n, d=1.0: jnp.fft.rfftfreq(n, d),
+    # ---- special remainder ----
+    "special_modified_bessel_i0": jax.scipy.special.i0,
+    "special_modified_bessel_i1": jax.scipy.special.i1,
+    "special_modified_bessel_k0": _bessel_k0,
+    "special_modified_bessel_k1": _bessel_k1,
+    "special_scaled_modified_bessel_k0": lambda x: _bessel_k0(x) * jnp.exp(x),
+    "special_scaled_modified_bessel_k1": lambda x: _bessel_k1(x) * jnp.exp(x),
+    "special_bessel_j0": lambda x: _bessel_j(x, 0),
+    "special_bessel_j1": lambda x: _bessel_j(x, 1),
+    "special_spherical_bessel_j0": lambda x: jnp.sinc(x / jnp.pi),
+    "special_chebyshev_polynomial_t": chebyshev_t,
+    "special_chebyshev_polynomial_u": chebyshev_u,
+    "special_chebyshev_polynomial_v": chebyshev_v,
+    "special_chebyshev_polynomial_w": chebyshev_w,
+    "special_shifted_chebyshev_polynomial_t": lambda x, n: chebyshev_t(2 * x - 1, n),
+    "special_shifted_chebyshev_polynomial_u": lambda x, n: chebyshev_u(2 * x - 1, n),
+    "special_shifted_chebyshev_polynomial_v": lambda x, n: chebyshev_v(2 * x - 1, n),
+    "special_shifted_chebyshev_polynomial_w": lambda x, n: chebyshev_w(2 * x - 1, n),
+    "special_hermite_polynomial_h": hermite_h,
+    "special_hermite_polynomial_he": hermite_he,
+    "special_laguerre_polynomial_l": laguerre_l,
+    "special_legendre_polynomial_p": legendre_p,
+    # ---- views/copies (functional backend: *_copy == the view op) ----
+    "expand_copy": lambda a, size, implicit=False: jnp.broadcast_to(
+        a, tuple(a.shape[i - (len(size) - a.ndim)] if s == -1 else s
+                 for i, s in enumerate(size))),
+    "permute_copy": lambda a, dims: jnp.transpose(a, tuple(dims)),
+    "squeeze_copy": lambda a, dim=None: jnp.squeeze(a, dim),
+    "unsqueeze_copy": lambda a, dim: jnp.expand_dims(a, dim),
+    "transpose_copy": lambda a, dim0, dim1: jnp.swapaxes(a, dim0, dim1),
+    "t_copy": lambda a: a.T,
+    "view_copy": lambda a, size: jnp.reshape(a, tuple(size)),
+    "detach_copy": lambda a: a,
+    "diagonal_copy": lambda a, offset=0, dim1=0, dim2=1: jnp.diagonal(a, offset, dim1, dim2),
+    "slice_copy": lambda a, dim=0, start=None, end=None, step=1: jax.lax.slice_in_dim(
+        a, start or 0, a.shape[dim] if end is None or end > a.shape[dim] else end,
+        stride=step, axis=dim),
+    "select_copy": lambda a, dim, index: jnp.take(a, index, axis=dim),
+    "split_copy": lambda a, split_size, dim=0: tuple(
+        jnp.split(a, list(range(split_size, a.shape[dim], split_size)), axis=dim)),
+    "split_with_sizes": lambda a, split_sizes, dim=0: tuple(
+        jnp.split(a, np.cumsum(split_sizes)[:-1].tolist(), axis=dim)),
+    "split_with_sizes_copy": lambda a, split_sizes, dim=0: tuple(
+        jnp.split(a, np.cumsum(split_sizes)[:-1].tolist(), axis=dim)),
+    "unbind_copy": lambda a, dim=0: tuple(
+        jnp.squeeze(x, dim) for x in jnp.split(a, a.shape[dim], axis=dim)),
+    "unfold_copy": lambda a, dimension, size, step: _unfold_ext(a, dimension, size, step),
+    "view_as_real_copy": lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1),
+    "view_as_complex_copy": lambda a: jax.lax.complex(a[..., 0], a[..., 1]),
+    "as_strided": _as_strided,
+    "as_strided_copy": _as_strided,
+    "as_strided_scatter": _as_strided_scatter,
+    "narrow": lambda a, dim, start, length: jax.lax.slice_in_dim(a, start, start + length, axis=dim),
+    "dsplit": lambda a, sections: tuple(jnp.dsplit(a, sections)),
+    "hsplit": lambda a, sections: tuple(jnp.hsplit(a, sections)),
+    "vsplit": lambda a, sections: tuple(jnp.vsplit(a, sections)),
+    "unsafe_chunk": lambda a, chunks, dim=0: tuple(jnp.array_split(a, chunks, axis=dim)),
+    "unsafe_split": lambda a, split_size, dim=0: tuple(
+        jnp.split(a, list(range(split_size, a.shape[dim], split_size)), axis=dim)),
+    "unsafe_split_with_sizes": lambda a, split_sizes, dim=0: tuple(
+        jnp.split(a, np.cumsum(split_sizes)[:-1].tolist(), axis=dim)),
+    # ---- construction / combination ----
+    "block_diag": lambda *ts: jax.scipy.linalg.block_diag(*ts),
+    "broadcast_tensors": lambda *ts: tuple(jnp.broadcast_arrays(*ts)),
+    "cartesian_prod": _cartesian_prod,
+    "combinations": _combinations,
+    "complex": jax.lax.complex,
+    "constant_pad_nd": _constant_pad_nd,
+    "diag": lambda a, diagonal=0: jnp.diag(a, diagonal),
+    "new_zeros": lambda a, size, dtype=None, **kw: jnp.zeros(
+        tuple(size) if isinstance(size, (tuple, list)) else (size,), dtype or a.dtype),
+    "new_ones": lambda a, size, dtype=None, **kw: jnp.ones(
+        tuple(size) if isinstance(size, (tuple, list)) else (size,), dtype or a.dtype),
+    "new_full": lambda a, size, fill_value, dtype=None, **kw: jnp.full(
+        tuple(size), fill_value, dtype or a.dtype),
+    "new_tensor": lambda a, data, dtype=None, **kw: jnp.asarray(data, dtype or a.dtype),
+    "reshape_as": lambda a, other: jnp.reshape(a, other.shape),
+    "sum_to_size": _sum_to_size,
+    "scalar_tensor": lambda s, dtype=None, **kw: jnp.asarray(s, dtype),
+    # ---- scatter/index family ----
+    "index_fill": _index_fill,
+    "masked_scatter": _masked_scatter,
+    "put": lambda a, index, source, accumulate=False: (
+        jnp.ravel(a).at[index].add(jnp.ravel(source)) if accumulate
+        else jnp.ravel(a).at[index].set(jnp.ravel(source))).reshape(a.shape),
+    "scatter_reduce": lambda a, dim, index, src, reduce, include_self=True:
+        _scatter_nd_along(a, dim, index, src,
+                          {"sum": "sum", "prod": "prod", "mean": "mean",
+                           "amax": "amax", "amin": "amin"}[reduce], include_self),
+    "index_reduce": lambda a, dim, index, source, reduce, include_self=True:
+        _index_reduce(a, dim, index, source, reduce, include_self),
+    "select_scatter": lambda a, src, dim, index: jnp.moveaxis(
+        jnp.moveaxis(a, dim, 0).at[index].set(src), 0, dim),
+    "slice_scatter": lambda a, src, dim=0, start=None, end=None, step=1: jnp.moveaxis(
+        jnp.moveaxis(a, dim, 0).at[slice(start, end, step)].set(jnp.moveaxis(src, dim, 0)),
+        0, dim),
+    # ---- nn.functional long tail ----
+    "adaptive_avg_pool1d": _adaptive_avg_pool1d,
+    "adaptive_max_pool1d": _adaptive_max_pool1d,
+    "adaptive_max_pool1d_with_indices": lambda a, output_size: _adaptive_max_pool1d(
+        a, output_size, return_indices=True),
+    "adaptive_avg_pool3d": _adaptive_avg_pool3d,
+    "adaptive_max_pool3d": _adaptive_max_pool3d,
+    "max_pool1d_with_indices": lambda a, kernel_size, stride=None, padding=0, dilation=1,
+        ceil_mode=False: _windowed_extrema_pool(a, 1, kernel_size, stride, padding, True,
+                                                dilation, ceil_mode),
+    "max_pool2d_with_indices": lambda a, kernel_size, stride=None, padding=0, dilation=1,
+        ceil_mode=False: _windowed_extrema_pool(a, 2, kernel_size, stride, padding, True,
+                                                dilation, ceil_mode),
+    "max_pool3d_with_indices": lambda a, kernel_size, stride=None, padding=0, dilation=1,
+        ceil_mode=False: _windowed_extrema_pool(a, 3, kernel_size, stride, padding, True,
+                                                dilation, ceil_mode),
+    "max_unpool1d": lambda a, indices, kernel_size, stride=None, padding=0, output_size=None:
+        _max_unpool(a, indices, 1, kernel_size, stride, padding, output_size),
+    "max_unpool2d": lambda a, indices, kernel_size, stride=None, padding=0, output_size=None:
+        _max_unpool(a, indices, 2, kernel_size, stride, padding, output_size),
+    "max_unpool3d": lambda a, indices, kernel_size, stride=None, padding=0, output_size=None:
+        _max_unpool(a, indices, 3, kernel_size, stride, padding, output_size),
+    "lp_pool1d": lambda a, norm_type, kernel_size, stride=None, ceil_mode=False:
+        _lp_pool(a, 1, norm_type, kernel_size, stride, ceil_mode),
+    "lp_pool3d": lambda a, norm_type, kernel_size, stride=None, ceil_mode=False:
+        _lp_pool(a, 3, norm_type, kernel_size, stride, ceil_mode),
+    "bilinear": _bilinear,
+    "pdist": _pdist,
+    "grid_sample": _grid_sample,
+    "grid_sampler": lambda a, grid, interpolation_mode, padding_mode, align_corners:
+        _grid_sample(a, grid, ["bilinear", "nearest", "bicubic"][interpolation_mode],
+                     ["zeros", "border", "reflection"][padding_mode], align_corners),
+    "grid_sampler_2d": lambda a, grid, interpolation_mode, padding_mode, align_corners:
+        _grid_sample(a, grid, ["bilinear", "nearest", "bicubic"][interpolation_mode],
+                     ["zeros", "border", "reflection"][padding_mode], align_corners),
+    "affine_grid": _affine_grid,
+    "affine_grid_generator": lambda theta, size, align_corners=False: _affine_grid(
+        theta, size, align_corners),
+    "poisson_nll_loss": lambda input, target, log_input=True, full=False, eps=1e-8,
+        reduction="mean": _reduce_ext(
+            (jnp.exp(input) - target * input) if log_input
+            else (input - target * jnp.log(input + eps)), reduction),
+    "multi_margin_loss": lambda input, target, p=1, margin=1.0, weight=None,
+        reduction="mean": _multi_margin_loss(input, target, p, margin, weight, reduction),
+    "multilabel_margin_loss": lambda input, target, reduction="mean":
+        _multilabel_margin_loss(input, target, reduction),
+    "triplet_margin_with_distance_loss": lambda anchor, positive, negative,
+        distance_function=None, margin=1.0, swap=False, reduction="mean":
+        _triplet_margin_distance(anchor, positive, negative, distance_function,
+                                 margin, swap, reduction),
+    "ctc_loss": _ctc_loss,
+    # ---- rnn cells ----
+    "gru_cell": _gru_cell,
+    "lstm_cell": _lstm_cell,
+    "rnn_tanh_cell": lambda x, hx, w_ih, w_hh, b_ih=None, b_hh=None: _rnn_cell(
+        x, hx, w_ih, w_hh, b_ih, b_hh, jnp.tanh),
+    "rnn_relu_cell": lambda x, hx, w_ih, w_hh, b_ih=None, b_hh=None: _rnn_cell(
+        x, hx, w_ih, w_hh, b_ih, b_hh, jax.nn.relu),
+    # ---- norm internals (pure subset; the in-place running-stat variants
+    # stay on the frontend's functionalized module path) ----
+    "batch_norm_stats": _batch_norm_stats,
+    "batch_norm_elemt": lambda a, weight, bias, mean, invstd, eps: (
+        (a - mean.reshape(1, -1, *([1] * (a.ndim - 2)))) *
+        invstd.reshape(1, -1, *([1] * (a.ndim - 2))) *
+        (1.0 if weight is None else weight.reshape(1, -1, *([1] * (a.ndim - 2)))) +
+        (0.0 if bias is None else bias.reshape(1, -1, *([1] * (a.ndim - 2))))),
+    "native_layer_norm": _native_layer_norm,
+    "native_group_norm": _native_group_norm,
+    "native_channel_shuffle": lambda a, groups: a.reshape(
+        a.shape[0], groups, a.shape[1] // groups, *a.shape[2:]).swapaxes(1, 2).reshape(a.shape),
+    # ---- signal ----
+    "stft": _stft,
+    "istft": _istft,
+    # ---- misc ----
+    "conv_tbc": _conv_tbc,
+    "resolve_conj": lambda a: a,
+    "resolve_neg": lambda a: a,
+}
+
+
+# overlap with torch semantics needing more code
+def _median(a, dim=None, keepdim=False):
+    """torch.median: the LOWER middle element (not the numpy average)."""
+    if dim is None:
+        flat = jnp.ravel(a)
+        return jnp.sort(flat)[(flat.shape[0] - 1) // 2]
+    k = (a.shape[dim] - 1) // 2
+    vals = jnp.take(jnp.sort(a, axis=dim), k, axis=dim)
+    idxs = jnp.take(jnp.argsort(a, axis=dim), k, axis=dim).astype(jnp.int32)
+    if keepdim:
+        vals = jnp.expand_dims(vals, dim)
+        idxs = jnp.expand_dims(idxs, dim)
+    return vals, idxs
+
+
+def _torch_svd(a, some=True):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=not some)
+    return u, s, jnp.conjugate(jnp.swapaxes(vh, -2, -1))
+
+
+def _unfold_ext(a, dimension, size, step):
+    n = (a.shape[dimension] - size) // step + 1
+    idx = jnp.arange(n) * step
+    moved = jnp.moveaxis(a, dimension, -1)
+    windows = jnp.stack([moved[..., int(i):int(i) + size] for i in (np.arange(n) * step)], axis=-2)
+    return jnp.moveaxis(windows, (-2, -1), (dimension, a.ndim))
+
+
+def _cummax_indices(a, dim):
+    vals = jax.lax.cummax(a, axis=dim)
+    eq = a == vals
+    ar = jnp.arange(a.shape[dim]).reshape([-1 if i == (dim % a.ndim) else 1 for i in range(a.ndim)])
+    return jax.lax.cummax(jnp.where(eq, ar, 0), axis=dim).astype(jnp.int32)
+
+
+def _index_reduce(a, dim, index, source, reduce, include_self=True):
+    moved = jnp.moveaxis(a, dim, 0)
+    src = jnp.moveaxis(source, dim, 0)
+    if reduce == "prod":
+        base = moved if include_self else moved.at[index].set(1.0)
+        out = base.at[index].multiply(src)
+    elif reduce == "amax":
+        base = moved if include_self else moved.at[index].set(-jnp.inf)
+        out = base.at[index].max(src)
+    elif reduce == "amin":
+        base = moved if include_self else moved.at[index].set(jnp.inf)
+        out = base.at[index].min(src)
+    elif reduce == "mean":
+        ssum = (moved if include_self else moved.at[index].set(0.0)).at[index].add(src)
+        cnt = (jnp.ones_like(moved) if include_self
+               else jnp.ones_like(moved).at[index].set(0.0)).at[index].add(jnp.ones_like(src))
+        out = ssum / cnt
+    else:
+        raise NotImplementedError(f"index_reduce mode {reduce!r}")
+    return jnp.moveaxis(out, 0, dim)
+
+
+def _reduce_ext(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+def _multi_margin_loss(input, target, p=1, margin=1.0, weight=None, reduction="mean"):
+    n, c = input.shape
+    picked = jnp.take_along_axis(input, target[:, None], 1)
+    m = jnp.maximum(margin - picked + input, 0.0) ** p
+    if weight is not None:
+        m = m * weight[target][:, None]
+    onehot = jax.nn.one_hot(target, c, dtype=bool)
+    per = jnp.sum(jnp.where(onehot, 0.0, m), axis=1) / c
+    return _reduce_ext(per, reduction)
+
+
+def _multilabel_margin_loss(input, target, reduction="mean"):
+    x = input if input.ndim == 2 else input[None]
+    t = target if target.ndim == 2 else target[None]
+    n, c = x.shape
+    valid = jnp.cumprod(t >= 0, axis=1).astype(bool)
+    tc = jnp.where(valid, jnp.clip(t, 0, c - 1), 0)
+    # max-scatter: duplicate (row, class) writes must OR, not overwrite
+    is_target = jnp.zeros((n, c), jnp.int32).at[
+        jnp.arange(n)[:, None], tc].max(valid.astype(jnp.int32)).astype(bool)
+    xt = jnp.where(valid, jnp.take_along_axis(x, tc, 1), 0.0)
+    diff = jnp.maximum(1.0 - xt[:, :, None] + x[:, None, :], 0.0)  # (n, targets, classes)
+    mask = valid[:, :, None] & ~is_target[:, None, :]
+    per = jnp.sum(jnp.where(mask, diff, 0.0), axis=(1, 2)) / c
+    return _reduce_ext(per if input.ndim == 2 else per[0], reduction)
+
+
+def _triplet_margin_distance(anchor, positive, negative, distance_function=None,
+                             margin=1.0, swap=False, reduction="mean"):
+    dist = distance_function or (lambda a, b: jnp.sqrt(jnp.sum((a - b) ** 2, -1) + 1e-12))
+    dp = dist(anchor, positive)
+    dn = dist(anchor, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce_ext(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+# ---------------------------------------------------------------------------
+# wave 6 — non-differentiable long tail
+# ---------------------------------------------------------------------------
+
+EXT_NONDIFF: dict[str, Callable] = {
+    "bool": lambda a: a.astype(jnp.bool_),
+    "byte": lambda a: a.astype(jnp.uint8),
+    "char": lambda a: a.astype(jnp.int8),
+    "short": lambda a: a.astype(jnp.int16),
+    "int": lambda a: a.astype(jnp.int32),
+    "count_nonzero": lambda a, dim=None: jnp.count_nonzero(a, axis=dim),
+    "nonzero_static": lambda a, size, fill_value=-1: jnp.stack(
+        jnp.nonzero(a, size=size, fill_value=fill_value), -1),
+    "histogram": lambda a, bins=100, range=None, weight=None, density=False: (
+        jnp.histogram(a, bins=bins, range=range, weights=weight, density=density)[0],
+        jnp.histogram(a, bins=bins, range=range, weights=weight, density=density)[1]),
+    "unravel_index": _unravel_index,
+    "mode": lambda a, dim=-1, keepdim=False: _mode(a, dim, keepdim),
+    "is_same_size": lambda a, b: a.shape == b.shape,
+}
+
+
+def _mode(a, dim=-1, keepdim=False):
+    # torch.mode: most frequent value along dim (smallest on ties) + index
+    s = jnp.sort(a, axis=dim)
+    moved = jnp.moveaxis(s, dim, -1)
+    n = moved.shape[-1]
+    runs = jnp.concatenate([jnp.ones(moved.shape[:-1] + (1,), bool),
+                            moved[..., 1:] != moved[..., :-1]], -1)
+    run_id = jnp.cumsum(runs, -1)
+    counts = jnp.sum(run_id[..., :, None] == run_id[..., None, :], -1)
+    best = jnp.argmax(counts, -1)
+    val = jnp.take_along_axis(moved, best[..., None], -1)[..., 0]
+    orig = jnp.moveaxis(a, dim, -1)
+    matches = orig == val[..., None]
+    idx = (n - 1) - jnp.argmax(jnp.flip(matches, -1), -1)  # torch: last matching index
+    if keepdim:
+        val, idx = val[..., None], idx[..., None]
+        val = jnp.moveaxis(val, -1, dim)
+        idx = jnp.moveaxis(idx, -1, dim)
+    return val, idx.astype(jnp.int32)
+
+
+def register_ext_catalog() -> int:
+    from .auto_register import _auto_symbols, register_auto_op
+
+    # wave-6 entries REPLACE earlier same-name registrations: these carry the
+    # fuller torch contract (dim/upper/some/... arguments) than the early
+    # single-argument versions
+    for name, fn in EXT_DIFF.items():
+        _auto_symbols.pop(f"auto.{name}", None)
+        register_auto_op(name, fn, differentiable=True)
+    for name, fn in EXT_NONDIFF.items():
+        _auto_symbols.pop(f"auto.{name}", None)
+        register_auto_op(name, fn, differentiable=False)
+    _register_ext2()
+    return len(_auto_symbols)
+
+
+# ---------------------------------------------------------------------------
+# wave 7 — full RNN stacks (lax.scan over time), fft hermitian 2d/nd, misc
+# ---------------------------------------------------------------------------
+
+
+def _rnn_stack(cell, x, h0s, params, has_biases, num_layers, bidirectional,
+               batch_first, state_is_tuple=False):
+    x = jnp.swapaxes(x, 0, 1) if batch_first else x
+    dirs = 2 if bidirectional else 1
+    per = 4 if has_biases else 2
+    finals = []
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(dirs):
+            base = (layer * dirs + d) * per
+            w_ih, w_hh = params[base], params[base + 1]
+            b_ih = params[base + 2] if has_biases else None
+            b_hh = params[base + 3] if has_biases else None
+            h0 = h0s(layer * dirs + d)
+            seq = x if d == 0 else jnp.flip(x, 0)
+
+            def step(h, xt):
+                hn = cell(xt, h, w_ih, w_hh, b_ih, b_hh)
+                return hn, (hn[0] if state_is_tuple else hn)
+
+            hT, ys = jax.lax.scan(step, h0, seq)
+            if d == 1:
+                ys = jnp.flip(ys, 0)
+            layer_outs.append(ys)
+            finals.append(hT)
+        x = jnp.concatenate(layer_outs, -1) if dirs == 2 else layer_outs[0]
+    out = jnp.swapaxes(x, 0, 1) if batch_first else x
+    return out, finals
+
+
+def _check_rnn_dropout(dropout, train):
+    if train and dropout and float(dropout) > 0.0:
+        raise NotImplementedError(
+            "RNN/GRU/LSTM inter-layer dropout in training mode needs RNG "
+            "state the auto catalog does not carry (see the module "
+            "docstring's RNG-sampler exclusion); run with dropout=0 or "
+            "module.eval()")
+
+
+def _torch_rnn(cell, input, hx, params, has_biases, num_layers, dropout, train,
+               bidirectional, batch_first):
+    _check_rnn_dropout(dropout, train)
+    out, finals = _rnn_stack(cell, input, lambda i: hx[i], list(params), has_biases,
+                             int(num_layers), bool(bidirectional), bool(batch_first))
+    return out, jnp.stack(finals, 0)
+
+
+def _torch_lstm(input, hx, params, has_biases, num_layers, dropout, train,
+                bidirectional, batch_first):
+    _check_rnn_dropout(dropout, train)
+    h0, c0 = hx[0], hx[1]
+    out, finals = _rnn_stack(
+        lambda x, h, wi, wh, bi, bh: _lstm_cell(x, h, wi, wh, bi, bh),
+        input, lambda i: (h0[i], c0[i]), list(params), has_biases,
+        int(num_layers), bool(bidirectional), bool(batch_first), state_is_tuple=True)
+    return (out, jnp.stack([f[0] for f in finals], 0),
+            jnp.stack([f[1] for f in finals], 0))
+
+
+def _hfft2(a, s=None, dim=(-2, -1), norm=None):
+    # hermitian-symmetric input: complex fft over the leading dims FIRST,
+    # then the hermitian fft over the last (verified against torch)
+    out = a
+    for d in dim[:-1]:
+        out = jnp.fft.fft(out, axis=d, norm=norm)
+    return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=dim[-1], norm=norm)
+
+
+def _ihfft2(a, s=None, dim=(-2, -1), norm=None):
+    out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=dim[-1], norm=norm)
+    for d in dim[:-1]:
+        out = jnp.fft.ifft(out, axis=d, norm=norm)
+    return out
+
+
+def _adaptive_max_pool2d_with_indices(a, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    H, W = a.shape[-2:]
+    oh, ow = (int(o) if o is not None else s for o, s in zip(output_size, (H, W)))
+    rows_v, rows_i = [], []
+    for sh, eh in _adaptive_pool_slices(H, oh):
+        cols_v, cols_i = [], []
+        for sw, ew in _adaptive_pool_slices(W, ow):
+            win = a[..., sh:eh, sw:ew]
+            flat = win.reshape(win.shape[:-2] + (-1,))
+            am = jnp.argmax(flat, -1)
+            wh = ew - sw
+            iy = am // wh + sh
+            ix = am % wh + sw
+            cols_v.append(jnp.max(flat, -1))
+            cols_i.append(iy * W + ix)
+        rows_v.append(jnp.stack(cols_v, -1))
+        rows_i.append(jnp.stack(cols_i, -1))
+    return jnp.stack(rows_v, -2), jnp.stack(rows_i, -2).astype(jnp.int32)
+
+
+EXT2_DIFF: dict[str, Callable] = {
+    "gru": lambda input, hx, params, has_biases, num_layers, dropout, train,
+        bidirectional, batch_first: _torch_rnn(_gru_cell, input, hx, params, has_biases,
+                                               num_layers, dropout, train, bidirectional,
+                                               batch_first),
+    "rnn_tanh": lambda input, hx, params, has_biases, num_layers, dropout, train,
+        bidirectional, batch_first: _torch_rnn(
+            lambda x, h, wi, wh, bi, bh: _rnn_cell(x, h, wi, wh, bi, bh, jnp.tanh),
+            input, hx, params, has_biases, num_layers, dropout, train,
+            bidirectional, batch_first),
+    "rnn_relu": lambda input, hx, params, has_biases, num_layers, dropout, train,
+        bidirectional, batch_first: _torch_rnn(
+            lambda x, h, wi, wh, bi, bh: _rnn_cell(x, h, wi, wh, bi, bh, jax.nn.relu),
+            input, hx, params, has_biases, num_layers, dropout, train,
+            bidirectional, batch_first),
+    "lstm": _torch_lstm,
+    "fft_hfft2": _hfft2,
+    "fft_ihfft2": _ihfft2,
+    "fft_hfftn": lambda a, s=None, dim=None, norm=None: _hfft2(
+        a, s, tuple(dim) if dim is not None else tuple(range(a.ndim)), norm),
+    "fft_ihfftn": lambda a, s=None, dim=None, norm=None: _ihfft2(
+        a, s, tuple(dim) if dim is not None else tuple(range(a.ndim)), norm),
+    "new_empty": lambda a, size, dtype=None, **kw: jnp.zeros(
+        tuple(size) if isinstance(size, (tuple, list)) else (size,), dtype or a.dtype),
+    "batch_norm_update_stats": lambda a, running_mean, running_var, momentum: (
+        (1 - momentum) * running_mean + momentum * jnp.mean(a, (0,) + tuple(range(2, a.ndim))),
+        (1 - momentum) * running_var + momentum * jnp.var(
+            a, (0,) + tuple(range(2, a.ndim)), ddof=1)),
+    "lu": _lu_factor,  # torch.lu / Tensor.lu -> (LU, pivots)
+    "adaptive_max_pool2d_with_indices": _adaptive_max_pool2d_with_indices,
+}
+
+
+def _register_ext2():
+    from .auto_register import _auto_symbols, register_auto_op
+
+    for name, fn in EXT2_DIFF.items():
+        _auto_symbols.pop(f"auto.{name}", None)
+        register_auto_op(name, fn, differentiable=True)
+

@@ -525,6 +525,9 @@ def register_catalog() -> int:
     for name, fn in _CATALOG_NONDIFF.items():
         if f"auto.{name}" not in _auto_symbols:
             register_auto_op(name, fn, differentiable=False)
+    from .auto_catalog_ext import register_ext_catalog
+
+    register_ext_catalog()
     return len(_auto_symbols)
 
 

@@ -2519,6 +2519,44 @@ def dropout2d(a, p=0.5, training=True, *, key=None):
     return clang.mul(clang.mul(a, mask), 1.0 / keep)
 
 
+@torchsymbol(name="dropout1d", id="torch.nn.functional.dropout1d")
+def dropout1d(a, p=0.5, training=True, *, key=None):
+    """Channel-wise dropout for (N, C, L) / (C, L)."""
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "dropout1d in training mode requires an rng key (key=)")
+    keep = 1.0 - p
+    nch = 2 if a.ndim == 3 else 1
+    mask_shape = a.shape[:nch] + (1,) * (a.ndim - nch)
+    mask = clang.lt(prims.uniform(mask_shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    mask = clang.expand_to(clang.maybe_convert_to_dtype(mask, a.dtype), a.shape)
+    return clang.mul(clang.mul(a, mask), 1.0 / keep)
+
+
+@torchsymbol(name="dropout3d", id="torch.nn.functional.dropout3d")
+def dropout3d(a, p=0.5, training=True, *, key=None):
+    """Channel-wise dropout for (N, C, D, H, W) / unbatched (C, D, H, W)."""
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "dropout3d in training mode requires an rng key (key=)")
+    keep = 1.0 - p
+    nch = 2 if a.ndim == 5 else 1  # torch: 4-D input is unbatched (C, D, H, W)
+    mask_shape = a.shape[:nch] + (1,) * (a.ndim - nch)
+    mask = clang.lt(prims.uniform(mask_shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    mask = clang.expand_to(clang.maybe_convert_to_dtype(mask, a.dtype), a.shape)
+    return clang.mul(clang.mul(a, mask), 1.0 / keep)
+
+
+@torchsymbol(name="feature_dropout", id="torch.nn.functional.feature_dropout")
+def feature_dropout(a, p=0.5, training=True, *, key=None):
+    """Channel-wise for >=3-D input; element-wise for 2-D (torch semantics)."""
+    if a.ndim >= 4:
+        return dropout2d.meta(a, p, training, key=key)
+    if a.ndim == 3:
+        return dropout1d.meta(a, p, training, key=key)
+    return dropout.meta(a, p, training, key=key)
+
+
 @torchsymbol(name="alpha_dropout", id="torch.nn.functional.alpha_dropout")
 def alpha_dropout(a, p=0.5, training=True, *, key=None):
     """SELU-preserving dropout (torch semantics: keeps self-normalizing stats)."""
@@ -2528,6 +2566,23 @@ def alpha_dropout(a, p=0.5, training=True, *, key=None):
     alpha_prime = -1.7580993408473766
     keep = 1.0 - p
     mask = clang.lt(prims.uniform(a.shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    A = (keep + alpha_prime * alpha_prime * keep * (1 - keep)) ** -0.5
+    Bc = -A * alpha_prime * (1 - keep)
+    dropped = clang.where(mask, a, clang.full_like(a, alpha_prime))
+    return clang.add(clang.mul(dropped, A), Bc)
+
+
+@torchsymbol(name="feature_alpha_dropout", id="torch.nn.functional.feature_alpha_dropout")
+def feature_alpha_dropout(a, p=0.5, training=True, *, key=None):
+    """Alpha dropout with a per-channel mask (torch semantics)."""
+    if not training or p == 0.0:
+        return a
+    check(key is not None, lambda: "feature_alpha_dropout in training mode requires an rng key (key=)")
+    alpha_prime = -1.7580993408473766
+    keep = 1.0 - p
+    mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+    mask = clang.lt(prims.uniform(mask_shape, 0.0, 1.0, key=key, dtype=dtypes.float32, device=a.device), keep)
+    mask = clang.expand_to(mask, a.shape)
     A = (keep + alpha_prime * alpha_prime * keep * (1 - keep)) ** -0.5
     Bc = -A * alpha_prime * (1 - keep)
     dropped = clang.where(mask, a, clang.full_like(a, alpha_prime))
