@@ -42,16 +42,28 @@ class OpInfo:
 
 
 class ExecutorMode:
-    """Test executor axis (reference TestExecutor subclasses, framework.py:152)."""
+    """Test executor axis (reference TestExecutor subclasses, framework.py:152).
 
-    def __init__(self, name: str, disable_fusion: bool):
+    ``interpretation`` selects the acquisition frontend: None = direct proxy
+    tracing, "python interpreter" = the CPython bytecode interpreter
+    (reference per-executor instantiation, thunder/tests/framework.py:381-472,
+    which runs the OpInfo matrix under every frontend)."""
+
+    def __init__(self, name: str, disable_fusion: bool, interpretation: str | None = None):
         self.name = name
         self.disable_fusion = disable_fusion
+        self.interpretation = interpretation
+
+    def jit(self, fn, **kw):
+        if self.interpretation is not None:
+            kw["interpretation"] = self.interpretation
+        return tt.jit(fn, disable_fusion=self.disable_fusion, **kw)
 
 
 EXECUTOR_MODES = (
     ExecutorMode("fused", disable_fusion=False),
     ExecutorMode("opbyop", disable_fusion=True),
+    ExecutorMode("interp", disable_fusion=False, interpretation="python interpreter"),
 )
 
 
@@ -93,7 +105,7 @@ def run_op_test(opinfo: OpInfo, mode: ExecutorMode, dtype, rng):
     found = False
     for sample in opinfo.sample_generator(rng, dtype):
         found = True
-        cf = tt.jit(lambda *a, **kw: opinfo.op(*a, **kw), disable_fusion=mode.disable_fusion)
+        cf = mode.jit(lambda *a, **kw: opinfo.op(*a, **kw))
         out = cf(*sample.args, **sample.kwargs)
         ref_out = opinfo.ref(*sample.args, **sample.kwargs)
         flat_out = out if isinstance(out, (tuple, list)) else (out,)

@@ -94,3 +94,54 @@ def test_train_resume_checkpoint():
                 np.asarray(state["params"][k]), np.asarray(restored["params"][k]))
         m_tree = jax.tree_util.tree_leaves(restored["opt_state"])
         assert len(m_tree) == len(jax.tree_util.tree_leaves(step.opt_state))
+
+
+def test_state_dict_options_full_vs_sharded():
+    """full_state_dict un-shards (and unpads) params; sharded mode returns
+    the device views; cpu_offload yields host arrays (reference
+    StateDictOptions, thunder/distributed/checkpoint.py:28)."""
+    tm, step, _ = _trained_sharded_module()
+    full = dist_ckpt.get_model_state_dict(
+        tm, dist_ckpt.StateDictOptions(full_state_dict=True))
+    assert full["fc1.weight"].shape == (30, 16)  # unpadded full shape
+    assert isinstance(full["fc1.weight"], np.ndarray)
+    sharded = dist_ckpt.get_model_state_dict(tm)
+    # sharded view keeps the padded dim-0 shard layout (32 = 8 shards of 4)
+    assert sharded["fc1.weight"].shape[0] in (30, 32)
+    offloaded = dist_ckpt.get_model_state_dict(
+        tm, dist_ckpt.StateDictOptions(cpu_offload=True))
+    assert isinstance(offloaded["fc1.weight"], np.ndarray)
+    # full values must match the module's own reverse-transformed state_dict
+    ref = tm.state_dict()
+    np.testing.assert_allclose(full["fc1.weight"], np.asarray(ref["fc1.weight"]), atol=0)
+
+
+def test_rank0_only_options():
+    """rank0_only returns {} on non-zero processes; on process 0 (this test
+    host) it behaves like a normal gather."""
+    tm, step, _ = _trained_sharded_module()
+    opts = dist_ckpt.StateDictOptions(full_state_dict=True, rank0_only=True)
+    sd = dist_ckpt.get_model_state_dict(tm, opts)
+    assert jax.process_index() == 0 and sd  # single-host: we ARE rank 0
+    with tempfile.TemporaryDirectory() as td:
+        dist_ckpt.save(sd, os.path.join(td, "c"), options=opts)
+        back = dist_ckpt.load(os.path.join(td, "c"), like=sd)
+        np.testing.assert_allclose(np.asarray(back["fc1.weight"]),
+                                   np.asarray(sd["fc1.weight"]), atol=0)
+
+
+def test_async_save_round_trip():
+    """async_save returns immediately; wait() makes the snapshot durable even
+    if the params are mutated right after the call (host snapshot)."""
+    tm, step, (x, y) = _trained_sharded_module()
+    sd = {k: p.data for k, p in tm.get_parameters().items()}
+    want = {k: np.asarray(v).copy() for k, v in sd.items()}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "async_ckpt")
+        handle = dist_ckpt.async_save(sd, path)
+        step(x, y)  # mutate params while the save is in flight
+        handle.wait()
+        back = dist_ckpt.load(path, like=sd)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(back[k]), want[k], atol=0,
+                                       err_msg=k)

@@ -337,3 +337,29 @@ def test_inplace_into_fusion_detected(rng):
     ]
     with pytest.raises(TraceCheckError, match="in-place"):
         check_inplace_into_fusion(trc)
+
+
+def test_getitem_list_index(rng):
+    """x[[0, 2]] advanced indexing with a Python list (review r3 finding)."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.ops import clang
+
+    x = jnp.asarray(rng.randn(3, 4).astype("float32"))
+    out = tt.jit(lambda a: clang.getitem(a, [0, 2]))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x)[[0, 2]])
+    out2 = tt.jit(lambda a: clang.getitem(a, ([2, 0], slice(None))))(x)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(x)[[2, 0], :])
+
+
+def test_masked_fill_concrete_mask(rng):
+    """masked_fill with a closure-captured concrete jax mask (review r3)."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.ops import ltorch
+
+    mask = jnp.asarray([[True, False, True]])
+    x = jnp.asarray(rng.randn(2, 3).astype("float32"))
+    out = tt.jit(lambda a: ltorch.masked_fill(a, mask, 0.0))(x)
+    want = np.where(np.asarray(mask), 0.0, np.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), want)

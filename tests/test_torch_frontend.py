@@ -415,3 +415,19 @@ def test_tensor_metadata_methods():
 
     out = tt.jit(Meta())(jnp.ones((2, 3), jnp.float32))
     np.testing.assert_allclose(float(out), 12.0)
+
+
+def test_hf_coverage_harness_subset():
+    """The HF coverage harness (reference jit_coverage_hf.py role): fwd+bwd
+    parity on two architectures (the full matrix runs via
+    `python -m thunder_tpu.benchmarks.hf_coverage`)."""
+    pytest.importorskip("transformers")
+    from thunder_tpu.benchmarks.hf_coverage import _configs, run_model
+
+    cfgs = _configs()
+    for name in ("qwen2", "bert"):
+        cfg, kind = cfgs[name]
+        rec = run_model(name, cfg, kind)
+        assert rec["status"] == "ok", rec
+        assert rec["max_abs_err"] < 1e-4 and rec["bwd_max_rel_err"] < 1e-4
+        assert rec["fallbacks"] == []

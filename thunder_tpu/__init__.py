@@ -205,6 +205,14 @@ class ThunderCompiledFunction(EpilogueMixin):
         cs = self._cs
         cs.calls += 1
         leaves, _ = tree_flatten((args, kwargs))
+        from .core.proxies import Proxy as _Proxy
+
+        if any(isinstance(l, _Proxy) for l in leaves):
+            # called under an ambient thunder trace (e.g. value_and_grad over
+            # a wrapper that closes over this compiled fn): inline-trace the
+            # original function into the ambient trace instead of executing a
+            # cached concrete entry on proxies
+            return self._cd.fn(*args, **kwargs)
         tensor_mask = [_is_tensor_like(l) for l in leaves]
         key = _cache_key(leaves, tensor_mask)
         extra = getattr(self._cd.fn, "__cache_extra__", None)

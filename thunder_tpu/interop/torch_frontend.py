@@ -1108,9 +1108,17 @@ class TorchTracedModule:
 
     def __call__(self, params: dict, args: tuple, kwargs: dict):
         # wrap proxies as torch trace tensors; buffers passed in `params`
-        # ride as inputs (mutations must not hit baked constants)
-        wrapped_state = {k: TraceTensor(v) if isinstance(v, TensorProxy) else v
-                         for k, v in params.items()}
+        # ride as inputs (mutations must not hit baked constants). Concrete
+        # jax arrays (the ambient-trace inline path: this module called from
+        # inside another thunder trace) become trace constants.
+        def wrap_leaf(v):
+            if isinstance(v, TensorProxy):
+                return TraceTensor(v)
+            if hasattr(v, "shape") and hasattr(v, "dtype") and not isinstance(v, torch.Tensor):
+                return TraceTensor(clang.constant(v))
+            return v
+
+        wrapped_state = {k: wrap_leaf(v) for k, v in params.items()}
         for k, v in self.buffers.items():
             if k in params and isinstance(params[k], TensorProxy):
                 t = wrapped_state[k]
@@ -1118,8 +1126,8 @@ class TorchTracedModule:
                 t = TraceTensor(clang.constant(v))
             t._owner = (self, k)  # in-place writes become epilogue effects
             wrapped_state[k] = t
-        wargs = tuple(TraceTensor(a) if isinstance(a, TensorProxy) else a for a in args)
-        wkwargs = {k: TraceTensor(v) if isinstance(v, TensorProxy) else v for k, v in kwargs.items()}
+        wargs = tuple(wrap_leaf(a) for a in args)
+        wkwargs = {k: wrap_leaf(v) for k, v in kwargs.items()}
         out = torch.func.functional_call(self.torch_module, wrapped_state, wargs, wkwargs)
         return _unwrap_output(out)
 

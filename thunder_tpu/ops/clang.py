@@ -417,13 +417,22 @@ def movedim(a: TensorProxy, source, destination) -> TensorProxy:
 
 
 def getitem(a: TensorProxy, key):
-    """Basic indexing (int/slice/None/Ellipsis/tensor) — the subset models use."""
+    """Basic indexing (int/slice/None/Ellipsis/tensor) — the subset models use.
+    Python-list index elements (x[[0, 2]] advanced indexing) lower as int
+    tensor indices."""
     if not isinstance(key, tuple):
         key = (key,)
-    # expand Ellipsis
+    key = tuple(
+        tensor_from_sequence(k, dtype=dtypes.int32, device=a.device)
+        if isinstance(k, list) and k and all(isinstance(e, (int, NumberProxy)) for e in k)
+        else k
+        for k in key)
+    # expand Ellipsis — identity checks only: `in`/`.index` would run
+    # TensorProxy.__eq__ against Ellipsis and bake bogus comparisons
     n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)
-    if Ellipsis in key:
-        i = key.index(Ellipsis)
+    ell = [i for i, k in enumerate(key) if k is Ellipsis]
+    if ell:
+        i = ell[0]
         key = key[:i] + (slice(None),) * (a.ndim - n_specified) + key[i + 1 :]
     else:
         key = key + (slice(None),) * (a.ndim - n_specified)

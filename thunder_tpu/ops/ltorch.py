@@ -263,6 +263,8 @@ def mish(a):
 
 @torchsymbol(name="clamp", method_names=("clamp", "clip"))
 def clamp(a, min=None, max=None):
+    check(min is not None or max is not None,
+          lambda: "clamp: at least one of min or max must not be None")
     if min is not None:
         a = clang.maximum(a, min)
     if max is not None:
@@ -272,6 +274,9 @@ def clamp(a, min=None, max=None):
 
 @torchsymbol(name="masked_fill", method_names=("masked_fill",))
 def masked_fill(a, mask, value):
+    mdt = dtypes.to_dtype(getattr(mask, "dtype", None))  # proxy OR concrete dtype
+    check(mdt is None or mdt.is_bool,
+          lambda: f"masked_fill expects a bool mask, got {mdt.name}")
     return clang.where(mask, value, a)
 
 
@@ -282,6 +287,7 @@ def where(pred, a, b):
 
 @torchsymbol(name="tril", method_names=("tril",))
 def tril(a, diagonal=0):
+    check(a.ndim >= 2, lambda: f"tril expects a tensor with at least 2 dims, got {a.ndim}")
     rows, cols = a.shape[-2], a.shape[-1]
     r = clang.unsqueeze(prims.iota(rows, dtype=dtypes.int32, device=a.device), 1)
     c = clang.unsqueeze(prims.iota(cols, dtype=dtypes.int32, device=a.device), 0)
@@ -291,6 +297,7 @@ def tril(a, diagonal=0):
 
 @torchsymbol(name="triu", method_names=("triu",))
 def triu(a, diagonal=0):
+    check(a.ndim >= 2, lambda: f"triu expects a tensor with at least 2 dims, got {a.ndim}")
     rows, cols = a.shape[-2], a.shape[-1]
     r = clang.unsqueeze(prims.iota(rows, dtype=dtypes.int32, device=a.device), 1)
     c = clang.unsqueeze(prims.iota(cols, dtype=dtypes.int32, device=a.device), 0)
@@ -414,6 +421,8 @@ def one_hot(a, num_classes):
 def reshape(a, *shape):
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
+    check(builtins.sum(1 for d in shape if pyval(d) == -1) <= 1,
+          lambda: f"reshape can infer (-1) at most one dimension, got {shape}")
     return clang.reshape(a, shape)
 
 
@@ -452,7 +461,10 @@ def squeeze(a, dim=None):
 
 @torchsymbol(name="flatten", method_names=("flatten",))
 def flatten(a, start_dim=0, end_dim=-1):
-    return clang.flatten(a, pyval(start_dim), pyval(end_dim))
+    sd = canonicalize_dim(a.ndim, pyval(start_dim))
+    ed = canonicalize_dim(a.ndim, pyval(end_dim))
+    check(sd <= ed, lambda: f"flatten: start_dim {sd} must be <= end_dim {ed}")
+    return clang.flatten(a, sd, ed)
 
 
 @torchsymbol(name="expand", method_names=("expand",))
@@ -464,21 +476,36 @@ def expand(a, *shape):
 
 @torchsymbol(name="cat")
 def cat(tensors, dim=0):
-    return clang.cat(list(tensors), dim)
+    tensors = list(tensors)
+    check(len(tensors) > 0, lambda: "cat expects at least one tensor")
+    canonicalize_dim(tensors[0].ndim, pyval(dim))  # dim-range check
+    return clang.cat(tensors, dim)
 
 
 @torchsymbol(name="stack")
 def stack(tensors, dim=0):
-    return clang.stack(list(tensors), dim)
+    tensors = list(tensors)
+    check(len(tensors) > 0, lambda: "stack expects at least one tensor")
+    first = tuple(tensors[0].shape)
+    for t in tensors[1:]:
+        check(tuple(t.shape) == first,
+              lambda: f"stack expects tensors of the same shape, got {first} and {tuple(t.shape)}")
+    return clang.stack(tensors, dim)
 
 
 @torchsymbol(name="split", method_names=("split",))
 def split(a, split_size_or_sections, dim=0):
-    return clang.split(a, split_size_or_sections, pyval(dim))
+    d = canonicalize_dim(a.ndim, pyval(dim))
+    if isinstance(split_size_or_sections, (list, tuple)):
+        total = builtins.sum(pyval(x) for x in split_size_or_sections)
+        check(total == a.shape[d],
+              lambda: f"split sizes {split_size_or_sections} must sum to dim {d} size {a.shape[d]}, got {total}")
+    return clang.split(a, split_size_or_sections, d)
 
 
 @torchsymbol(name="chunk", method_names=("chunk",))
 def chunk(a, chunks, dim=0):
+    check(pyval(chunks) > 0, lambda: f"chunk expects a positive number of chunks, got {chunks}")
     return clang.chunk(a, pyval(chunks), pyval(dim))
 
 
@@ -516,7 +543,9 @@ def getitem(a, key):
 def index_select(a, dim, index):
     # lowers to the TAKE prim (hand-written grad rule) — a dedicated
     # INDEX_SELECT prim would duplicate it
-    return clang.take(a, index, pyval(dim))
+    check(getattr(index, "ndim", 1) == 1,
+          lambda: f"index_select expects a 1-D index vector, got {index.ndim}-D")
+    return clang.take(a, index, canonicalize_dim(a.ndim, pyval(dim)))
 
 
 @torchsymbol(name="gather", method_names=("gather",))
@@ -526,6 +555,8 @@ def gather(a, dim, index):
 
 @torchsymbol(name="take_along_dim", method_names=("take_along_dim",))
 def take_along_dim(a, indices, dim):
+    check(indices.ndim == a.ndim,
+          lambda: f"take_along_dim: indices rank {indices.ndim} must match input rank {a.ndim}")
     return clang.take_along_axis(a, indices, pyval(dim))
 
 
@@ -543,6 +574,10 @@ def scatter_add(a, dim, index, src):
 def pad(a, pad_widths, mode="constant", value=0.0):
     """torch.nn.functional.pad with the (last-dim-first) flat pad list."""
     check(mode == "constant", lambda: f"pad mode {mode} unsupported")
+    check(len(pad_widths) % 2 == 0,
+          lambda: f"pad expects an even number of pad values (left/right pairs), got {len(pad_widths)}")
+    check(len(pad_widths) // 2 <= a.ndim,
+          lambda: f"pad: {len(pad_widths)//2} padded dims exceed input rank {a.ndim}")
     cfg = [(0, 0, 0)] * a.ndim
     pairs = [(pyval(pad_widths[i]), pyval(pad_widths[i + 1])) for i in range(0, len(pad_widths), 2)]
     for i, (lo, hi) in enumerate(pairs):
@@ -552,6 +587,10 @@ def pad(a, pad_widths, mode="constant", value=0.0):
 
 @torchsymbol(name="roll", method_names=("roll",))
 def roll(a, shifts, dims=None):
+    if dims is not None and isinstance(shifts, (tuple, list)):
+        dlist = (dims,) if isinstance(dims, int) else dims
+        check(len(shifts) == len(dlist),
+              lambda: f"roll: shifts {shifts} and dims {dlist} must have the same length")
     if dims is None:
         flat = clang.reshape(a, (a.numel,))
         out = roll_1d(flat, pyval(shifts))
@@ -759,6 +798,8 @@ def conv1d(a, weight, bias=None, stride=(1,), padding=(0,), dilation=(1,), group
 @torchsymbol(name="layer_norm", id="torch.nn.functional.layer_norm")
 def layer_norm(a, normalized_shape, weight=None, bias=None, eps=1e-5):
     ndims = len(normalized_shape)
+    check(ndims <= a.ndim and tuple(int(d) for d in normalized_shape) == tuple(a.shape[a.ndim - ndims:]),
+          lambda: f"layer_norm: normalized_shape {tuple(normalized_shape)} must match the trailing dims of {tuple(a.shape)}")
     dims = tuple(range(a.ndim - ndims, a.ndim))
     compute = a if a.dtype == dtypes.float32 else clang.maybe_convert_to_dtype(a, dtypes.float32)
     m = clang.mean(compute, dims, keepdim=True)
@@ -791,6 +832,10 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, en
     """Scaled dot-product attention (composite; Pallas flash-attention executor
     claims this symbol whole — reference analog: sdpaex/cudnnex claiming,
     thunder/executors/sdpaex.py:1)."""
+    check(q.shape[-1] == k.shape[-1],
+          lambda: f"sdpa: q head dim {q.shape[-1]} must match k head dim {k.shape[-1]}")
+    check(k.shape[-2] == v.shape[-2],
+          lambda: f"sdpa: k length {k.shape[-2]} must match v length {v.shape[-2]}")
     if q.ndim == 4 and k.ndim == 4 and q.shape[1] != k.shape[1]:
         check(k.shape[1] == v.shape[1],
               lambda: f"k has {k.shape[1]} heads but v has {v.shape[1]}")
@@ -980,6 +1025,8 @@ def addmm(input, mat1, mat2, *, beta=1, alpha=1):
 
 @torchsymbol(name="outer", method_names=("outer",))
 def outer(a, b):
+    check(a.ndim == 1 and b.ndim == 1,
+          lambda: f"outer expects 1D vectors, got {a.ndim}-D and {b.ndim}-D")
     return clang.mul(clang.unsqueeze(a, 1), clang.unsqueeze(b, 0))
 
 
@@ -1324,6 +1371,8 @@ def median(a, dim=None, keepdim=False):
 @torchsymbol(name="norm", method_names=("norm",))
 def norm(a, p=2, dim=None, keepdim=False):
     p = pyval(p) if not isinstance(p, str) else p
+    check(isinstance(p, (int, float)) or p in ("fro", "inf"),
+          lambda: f"norm: ord/p must be a number or 'fro'/'inf', got {p!r}")
     if p == "fro" or p == 2:
         return prims.sqrt(clang.sum_(clang.mul(a, a), dim, keepdim))
     if p == "inf" or p == float("inf"):
@@ -1441,6 +1490,7 @@ def expand_as(a, other):
 def repeat_interleave(a, repeats, dim=None):
     check(isinstance(repeats, (int, NumberProxy)), lambda: "repeat_interleave: only int repeats supported (static shapes)")
     r = pyval(repeats)
+    check(r >= 0, lambda: f"repeat_interleave: repeats must be non-negative, got {r}")
     if dim is None:
         a = clang.reshape(a, (a.numel,))
         d = 0
@@ -1555,6 +1605,12 @@ def ravel(a):
 def unflatten(a, dim, sizes):
     dim = canonicalize_dim(a.ndim, pyval(dim))
     sizes = tuple(pyval(s) for s in sizes)
+    if -1 not in sizes:
+        prod = 1
+        for x in sizes:
+            prod *= x
+        check(prod == a.shape[dim],
+              lambda: f"unflatten: sizes {sizes} (product {prod}) must multiply to dim {dim} size {a.shape[dim]}")
     if -1 in sizes:
         known = 1
         for s in sizes:
@@ -1747,6 +1803,10 @@ def mm(a, b):
 @torchsymbol(name="bmm")
 def bmm(a, b):
     check(a.ndim == 3 and b.ndim == 3, lambda: "bmm expects 3D tensors")
+    check(a.shape[0] == b.shape[0],
+          lambda: f"bmm: batch sizes must match, got {a.shape[0]} and {b.shape[0]}")
+    check(a.shape[2] == b.shape[1],
+          lambda: f"bmm: cannot contract {tuple(a.shape)} with {tuple(b.shape)}")
     return prims.matmul(a, b)
 
 
@@ -1759,6 +1819,8 @@ def mv(a, b):
 @torchsymbol(name="dot", method_names=("dot",))
 def dot(a, b):
     check(a.ndim == 1 and b.ndim == 1, lambda: "dot expects 1D tensors")
+    check(a.shape[0] == b.shape[0],
+          lambda: f"dot: 1D tensors must have the same size, got {a.shape[0]} and {b.shape[0]}")
     return prims.matmul(a, b)
 
 
@@ -1984,6 +2046,8 @@ def max_pool3d(a, kernel_size, stride=None, padding=0):
 
 def _avg_pool(a, kernel_size, stride, padding, n, count_include_pad):
     ks, st, pd = _pool_args(kernel_size, stride, padding, n)
+    check(builtins.all(k > 0 for k in ks),
+          lambda: f"pooling kernel sizes must be positive, got {ks}")
     window = (1, 1) + ks
     strides = (1, 1) + st
     pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
@@ -2371,6 +2435,9 @@ def fold(a, output_size, kernel_size, dilation=1, padding=0, stride=1):
     """F.fold (col2im): (N, C*kh*kw, L) -> (N, C, H, W), overlaps summed."""
     H, W = _pair(output_size)
     kh, kw = _pair(kernel_size)
+    check(a.ndim == 3 and a.shape[1] % (kh * kw) == 0,
+          lambda: f"fold expects (N, C*kh*kw, L) input; dim 1 of {tuple(a.shape)} "
+                  f"is not divisible by the kernel block size {kh*kw}")
     dh, dw = _pair(dilation)
     ph, pw = _pair(padding)
     sh, sw = _pair(stride)

@@ -17,9 +17,14 @@ import numpy as np
 
 @dataclass
 class StateDictOptions:
-    """Reference thunder/distributed/checkpoint.py StateDictOptions."""
+    """Reference thunder/distributed/checkpoint.py StateDictOptions.
 
-    full_state_dict: bool = False  # gather to host-global arrays
+    full_state_dict: gather shards to full (unpadded) host-global arrays.
+    cpu_offload: move values to host numpy regardless of gathering.
+    rank0_only: only process 0 materializes/saves (other hosts get {}).
+    """
+
+    full_state_dict: bool = False
     cpu_offload: bool = False
     rank0_only: bool = False
 
@@ -36,6 +41,10 @@ def _orbax():
 def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None) -> None:
     """Save a (possibly sharded) param/optimizer state dict."""
     options = options or StateDictOptions()
+    if options.rank0_only and jax.process_index() != 0:
+        return
+    if options.full_state_dict or options.cpu_offload:
+        state_dict = jax.tree_util.tree_map(lambda x: np.asarray(x), state_dict)
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None:
@@ -57,9 +66,12 @@ def load(path: str, *, like: dict | None = None, options: StateDictOptions | Non
     if ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()
         if like is not None:
-            restore_args = jax.tree_util.tree_map(
-                lambda x: ocp.ArrayRestoreArgs(sharding=getattr(x, "sharding", None)), like
-            )
+            def _ra(x):
+                sh = getattr(x, "sharding", None)
+                # numpy leaves (full/cpu_offload state dicts) have no sharding
+                return ocp.ArrayRestoreArgs(sharding=sh) if sh is not None else ocp.RestoreArgs()
+
+            restore_args = jax.tree_util.tree_map(_ra, like)
             return ckptr.restore(path, restore_args=restore_args)
         return ckptr.restore(path)
     data = np.load(os.path.join(path, "state.npz"))
@@ -72,10 +84,19 @@ def load(path: str, *, like: dict | None = None, options: StateDictOptions | Non
 
 
 def get_model_state_dict(tmodule, options: StateDictOptions | None = None) -> dict:
-    """Reference get_model_state_dict: full mode gathers shards to host."""
+    """Reference get_model_state_dict: full mode gathers shards (un-sharding
+    and un-padding FSDP params via the module's state_dict reverse
+    transforms); sharded mode returns the per-device shard views."""
     options = options or StateDictOptions()
-    sd = {k: p.data for k, p in tmodule.get_parameters().items()}
+    if options.rank0_only and jax.process_index() != 0:
+        return {}
     if options.full_state_dict:
+        sd_fn = getattr(tmodule, "state_dict", None)
+        sd = dict(sd_fn()) if callable(sd_fn) else {
+            k: p.data for k, p in tmodule.get_parameters().items()}
+        return {k: np.asarray(v) for k, v in sd.items()}
+    sd = {k: p.data for k, p in tmodule.get_parameters().items()}
+    if options.cpu_offload:
         sd = {k: np.asarray(v) for k, v in sd.items()}
     return sd
 
@@ -97,6 +118,39 @@ def load_model_state_dict(sd: dict, tmodule, options: StateDictOptions | None = 
             except Exception:
                 pass
         p.data = arr
+
+
+class _AsyncHandle:
+    """Handle returned by async_save: wait() blocks until the write is durable."""
+
+    def __init__(self, waiter):
+        self._waiter = waiter
+
+    def wait(self) -> None:
+        self._waiter()
+
+
+def async_save(state_dict: dict, path: str, *,
+               options: StateDictOptions | None = None) -> _AsyncHandle:
+    """Asynchronous checkpoint save (reference async StateDictOptions role):
+    returns immediately; the training loop keeps stepping while orbax (or a
+    writer thread in the numpy fallback) persists the snapshot."""
+    options = options or StateDictOptions()
+    if options.rank0_only and jax.process_index() != 0:
+        return _AsyncHandle(lambda: None)
+    # snapshot to host first: the caller may donate/overwrite device buffers
+    # on the very next step
+    snap = jax.tree_util.tree_map(lambda x: np.asarray(x), state_dict)
+    ocp = _orbax()
+    if ocp is not None and hasattr(ocp, "AsyncCheckpointer"):
+        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        ckptr.save(os.path.abspath(path), snap, force=True)
+        return _AsyncHandle(ckptr.wait_until_finished)
+    import threading
+
+    t = threading.Thread(target=save, args=(snap, path), kwargs={"options": options})
+    t.start()
+    return _AsyncHandle(t.join)
 
 
 def save_checkpoint(step_or_state, path: str, *, tmodule=None, opt_state=None) -> None:

@@ -1403,8 +1403,20 @@ class TorchModuleValueAndGrad:
         return self._vag._cs
 
     def __call__(self, *args, **kwargs):
+        from ..interop.torch_frontend import torch_to_jax
+
+        def conv(x):
+            # accept torch tensors like CompiledTorchModule.__call__ does
+            if type(x).__module__.startswith("torch") and hasattr(x, "detach"):
+                return torch_to_jax(x)
+            if isinstance(x, (tuple, list)):
+                return type(x)(conv(e) for e in x)
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+
         state = {**self.ctm.get_parameters(), **self.ctm.get_buffers()}
-        loss, grads = self._vag(state, args, kwargs)
+        loss, grads = self._vag(state, conv(args), conv(kwargs))
         param_names = set(self.ctm.get_parameters())
         return loss, {k: g for k, g in grads[0][0].items() if k in param_names}
 
