@@ -120,7 +120,14 @@ def run_model(name: str, cfg, kind: str, *, check_backward: bool = True) -> dict
 
             ctm_loss = tt.jit(wrap)
             vag_args = (ids, mask) if mask is not None else (ids,)
-            lval, grads = tt.value_and_grad(ctm_loss)(*vag_args)
+            tf._eager_warned.clear()  # fwd dedup must not hide bwd fallbacks
+            with warnings.catch_warnings(record=True) as wb:
+                warnings.simplefilter("always")
+                lval, grads = tt.value_and_grad(ctm_loss)(*vag_args)
+            rec["fallbacks"] = sorted(set(rec["fallbacks"]) | {
+                m.group(1) for wi in wb
+                for m in [__import__("re").search(r"no mapping for ([\w.]+)", str(wi.message))]
+                if m})
             g = grads.get(tname)
             if g is None:
                 rec["status"] = f"bwd: no grad entry for {tname}"

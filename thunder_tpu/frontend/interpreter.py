@@ -152,6 +152,85 @@ def default_lookasides() -> dict[Any, Callable]:
     return dict(_global_lookasides)
 
 
+def _register_builtin_lookasides() -> None:
+    """Tensor-aware diversions of builtins (reference general-jit lookaside
+    table, thunder/core/jit_ext.py:411-1080): min/max over proxies cannot run
+    natively (bool() of a tensor comparison is data-dependent), len() needs
+    the static leading dim."""
+    import builtins
+
+    from ..core.proxies import TensorProxy
+
+    def _lt():
+        from ..ops import ltorch
+
+        return ltorch
+
+    def _has_multi_element(args):
+        return builtins.any(
+            isinstance(a, TensorProxy) and (a.ndim > 1 or (a.ndim == 1 and a.shape[0] > 1))
+            for a in args)
+
+    def _contains_tensor(x):
+        return isinstance(x, (list, tuple)) and builtins.any(
+            isinstance(e, TensorProxy) for e in x)
+
+    def _minmax(name, reduce_name, args, kwargs):
+        # torch semantics: min/max over a 1-D tensor reduces (each pairwise
+        # comparison is scalar); multi-element comparisons are ambiguous and
+        # must raise — NOT silently return an elementwise result
+        if len(args) == 1 and isinstance(args[0], TensorProxy):
+            t = args[0]
+            if t.ndim <= 1:
+                return getattr(_lt(), reduce_name)(t)
+            raise InterpreterError(
+                f"builtins.{name} over a {t.ndim}-D tensor compares whole "
+                f"rows (data-dependent, ambiguous in torch too); use "
+                f"ltorch.{reduce_name} for a reduction")
+        if _has_multi_element(args) or builtins.any(_contains_tensor(a) for a in args):
+            raise InterpreterError(
+                f"builtins.{name} comparing multi-element tensors is "
+                f"data-dependent (torch raises here too); use "
+                f"ltorch.{'minimum' if name == 'min' else 'maximum'} for an "
+                f"elementwise result or ltorch.{reduce_name} for a reduction")
+        return getattr(builtins, name)(*args, **kwargs)
+
+    @register_lookaside(builtins.min)
+    def _min_la(*args, **kwargs):
+        return _minmax("min", "amin", args, kwargs)
+
+    @register_lookaside(builtins.max)
+    def _max_la(*args, **kwargs):
+        return _minmax("max", "amax", args, kwargs)
+
+    @register_lookaside(builtins.len)
+    def _len_la(x):
+        if isinstance(x, TensorProxy):
+            if x.ndim == 0:
+                raise TypeError("len() of a 0-d tensor")
+            return int(x.shape[0])
+        return builtins.len(x)
+
+    @register_lookaside(builtins.sorted)
+    def _sorted_la(x, **kwargs):
+        if isinstance(x, TensorProxy):
+            if kwargs:
+                raise NotImplementedError("sorted(tensor, key=/reverse=) is not supported")
+            if x.ndim > 1:
+                raise InterpreterError(
+                    "sorted() over a >=2-D tensor compares whole rows "
+                    "(data-dependent); use ltorch.sort")
+            return _lt().sort(x, 0)[0]
+        if _contains_tensor(x) and _has_multi_element(list(x)):
+            raise InterpreterError(
+                "sorted() over a sequence of multi-element tensors is "
+                "data-dependent; use ltorch.sort on a stacked tensor")
+        return builtins.sorted(x, **kwargs)
+
+
+_register_builtin_lookasides()
+
+
 # modules whose functions run natively (opaque) rather than interpreted
 _OPAQUE_MODULE_PREFIXES = (
     "jax", "numpy", "thunder_tpu", "builtins", "math", "operator", "functools",
@@ -241,11 +320,43 @@ def _parse_exception_table(code: types.CodeType):
 # ---------------------------------------------------------------------------
 
 
+_GATE_WARNED = False
+
+
+def _check_python_version() -> None:
+    """Explicit version gate (reference spans 3.10-3.13 with per-version
+    handler tables, thunder/core/interpreter.py:1257). Here: CPython 3.12 is
+    the tested surface; 3.13 runs best-effort via the handlers for its new
+    opcodes (TO_BOOL / CALL_KW / fused FAST pairs / FORMAT_* split); anything
+    else is refused loudly — the direct-tracing frontend (the default
+    ``interpretation=None``) has no version sensitivity at all."""
+    import sys
+    import warnings
+
+    global _GATE_WARNED
+    vi = sys.version_info[:2]
+    if vi == (3, 12):
+        return
+    if vi == (3, 13):
+        if not _GATE_WARNED:
+            _GATE_WARNED = True
+            warnings.warn(
+                "thunder_tpu bytecode interpreter on CPython 3.13 is "
+                "best-effort (CI runs 3.12); the direct-tracing frontend "
+                "(interpretation=None) is version-independent")
+        return
+    raise InterpreterError(
+        f"the thunder_tpu bytecode interpreter supports CPython 3.12 (tested) "
+        f"and 3.13 (best-effort), not {vi[0]}.{vi[1]}; use the default "
+        f"direct-tracing frontend (interpretation=None)")
+
+
 class Interpreter:
     def __init__(self, *, lookasides: dict | None = None,
                  on_provenance_load: Callable[[Any, Provenance], Any] | None = None,
                  on_sharp_edge: Callable[[str], None] | None = None,
                  max_depth: int = 64, record_log: bool = False):
+        _check_python_version()
         self.lookasides = {**default_lookasides(), **(lookasides or {})}
         self.on_provenance_load = on_provenance_load
         self.on_sharp_edge = on_sharp_edge or (lambda msg: None)
@@ -745,6 +856,60 @@ class Interpreter:
         elif conv == 3:
             val = ascii(val)
         frame.push(wrap(format(val, spec), Provenance("op")))
+        return None
+
+    # ---- CPython 3.13 opcode surface (documented semantics; the CI image
+    # ships 3.12, so these run under the best-effort gate) ----
+
+    def op_TO_BOOL(self, frame, fn, ins):
+        # _truthy, not bool(): a TensorProxy branch must raise the loud
+        # data-dependent-control-flow error (3.12 jumps go through _truthy)
+        v = frame.pop()
+        frame.push(wrap(self._truthy(v), Provenance("op")))
+        return None
+
+    def op_CALL_KW(self, frame, fn, ins):
+        # 3.13 folds KW_NAMES into the call: the names tuple rides the stack
+        kwnames = unwrap(frame.pop())
+        frame._kwnames = tuple(kwnames)
+        return self.op_CALL(frame, fn, ins)
+
+    def op_LOAD_FAST_LOAD_FAST(self, frame, fn, ins):
+        for name in ins.argval:
+            if name not in frame.locals:
+                raise UnboundLocalError(f"local variable '{name}' referenced before assignment")
+            frame.push(frame.locals[name])
+        return None
+
+    def op_STORE_FAST_STORE_FAST(self, frame, fn, ins):
+        n1, n2 = ins.argval
+        frame.locals[n1] = frame.pop()
+        frame.locals[n2] = frame.pop()
+        return None
+
+    def op_STORE_FAST_LOAD_FAST(self, frame, fn, ins):
+        n_store, n_load = ins.argval
+        frame.locals[n_store] = frame.pop()
+        if n_load not in frame.locals:
+            raise UnboundLocalError(f"local variable '{n_load}' referenced before assignment")
+        frame.push(frame.locals[n_load])
+        return None
+
+    def op_CONVERT_VALUE(self, frame, fn, ins):
+        v = unwrap(frame.pop())
+        conv = {1: str, 2: repr, 3: ascii}[ins.arg]
+        frame.push(wrap(conv(v), Provenance("op")))
+        return None
+
+    def op_FORMAT_SIMPLE(self, frame, fn, ins):
+        v = unwrap(frame.pop())
+        frame.push(wrap(v if isinstance(v, str) else format(v), Provenance("op")))
+        return None
+
+    def op_FORMAT_WITH_SPEC(self, frame, fn, ins):
+        spec = unwrap(frame.pop())
+        v = unwrap(frame.pop())
+        frame.push(wrap(format(v, spec), Provenance("op")))
         return None
 
     def op_LIST_EXTEND(self, frame, fn, ins):

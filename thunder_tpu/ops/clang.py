@@ -422,11 +422,20 @@ def getitem(a: TensorProxy, key):
     tensor indices."""
     if not isinstance(key, tuple):
         key = (key,)
-    key = tuple(
-        tensor_from_sequence(k, dtype=dtypes.int32, device=a.device)
-        if isinstance(k, list) and k and all(isinstance(e, (int, NumberProxy)) for e in k)
-        else k
-        for k in key)
+    def _lower_list(k):
+        if not (isinstance(k, list) and k):
+            return k
+        if all(isinstance(e, bool) for e in k):
+            # a bool list is a MASK in torch/numpy — dynamic output shape
+            raise NotImplementedError(
+                "boolean mask list indexing (x[[True, False]]) has a "
+                "data-dependent output shape; use jnp-level masking or "
+                "masked_select via the torch interop host fallback")
+        if all(isinstance(e, (int, NumberProxy)) and not isinstance(e, bool) for e in k):
+            return tensor_from_sequence(k, dtype=dtypes.int32, device=a.device)
+        return k
+
+    key = tuple(_lower_list(k) for k in key)
     # expand Ellipsis — identity checks only: `in`/`.index` would run
     # TensorProxy.__eq__ against Ellipsis and bake bogus comparisons
     n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)

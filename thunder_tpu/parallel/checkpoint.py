@@ -148,9 +148,23 @@ def async_save(state_dict: dict, path: str, *,
         return _AsyncHandle(ckptr.wait_until_finished)
     import threading
 
-    t = threading.Thread(target=save, args=(snap, path), kwargs={"options": options})
+    err: list[BaseException] = []
+
+    def _write():
+        try:
+            save(snap, path, options=options)
+        except BaseException as e:  # re-raised from wait(): a swallowed
+            err.append(e)           # failure would fake durability
+
+    t = threading.Thread(target=_write)
     t.start()
-    return _AsyncHandle(t.join)
+
+    def _wait():
+        t.join()
+        if err:
+            raise err[0]
+
+    return _AsyncHandle(_wait)
 
 
 def save_checkpoint(step_or_state, path: str, *, tmodule=None, opt_state=None) -> None:
