@@ -113,3 +113,25 @@ class TestExamineCoverage:
         cos, sin = build_rope_cache(32, cfg.rope_n_elem, cfg.rope_base)
         x = jnp.asarray(rng.randn(2, 32, cfg.n_embd).astype(np.float32))
         self._check(blk, x, cos, sin)
+
+
+def test_fusion_report_and_zoo_coverage(rng):
+    """examine depth: per-fusion statistics and the model-zoo coverage sweep
+    (reference examine/__init__.py:210-311 + model coverage reports)."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.ops import ltorch
+    from thunder_tpu.utils.examine import fusion_report, model_zoo_coverage
+
+    cf = tt.jit(lambda a, b: ltorch.gelu(ltorch.matmul(a, b)))
+    x = jnp.asarray(rng.randn(8, 8).astype("float32"))
+    cf(x, x)
+    rep = fusion_report(cf)
+    assert rep and rep[0]["n_ops"] >= 2
+    assert rep[0]["input_bytes"] == 2 * 8 * 8 * 4
+    assert "matmul" in rep[0]["op_histogram"]
+
+    rows = model_zoo_coverage()
+    by_name = {r["model"]: r for r in rows}
+    assert by_name["tiny-llama2"]["ok"] and by_name["resnet18"]["ok"]
+    assert all(r.get("ok") for r in rows), rows

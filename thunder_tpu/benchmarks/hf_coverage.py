@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import time
 import traceback
 
@@ -56,6 +57,13 @@ def _configs():
     }
 
 
+def _scrape_fallbacks(warning_list) -> list[str]:
+    """Torch ops that hit the host-eager path, from the frontend's warning."""
+    return sorted({
+        m.group(1) for wi in warning_list
+        for m in [re.search(r"no mapping for ([\w.]+)", str(wi.message))] if m})
+
+
 def run_model(name: str, cfg, kind: str, *, check_backward: bool = True) -> dict:
     import warnings
 
@@ -92,10 +100,7 @@ def run_model(name: str, cfg, kind: str, *, check_backward: bool = True) -> dict
         logits = out["logits"] if isinstance(out, dict) else getattr(out, "logits", out[0])
         err = float(np.max(np.abs(np.asarray(logits) - ref.numpy())))
         rec["max_abs_err"] = err
-        rec["fallbacks"] = sorted({
-            m.group(1) for wi in w
-            for m in [__import__("re").search(r"no mapping for ([\w.]+)", str(wi.message))]
-            if m})
+        rec["fallbacks"] = _scrape_fallbacks(w)
         if err > 1e-2:
             rec["status"] = f"numerics ({err:.2e})"
 
@@ -124,10 +129,7 @@ def run_model(name: str, cfg, kind: str, *, check_backward: bool = True) -> dict
             with warnings.catch_warnings(record=True) as wb:
                 warnings.simplefilter("always")
                 lval, grads = tt.value_and_grad(ctm_loss)(*vag_args)
-            rec["fallbacks"] = sorted(set(rec["fallbacks"]) | {
-                m.group(1) for wi in wb
-                for m in [__import__("re").search(r"no mapping for ([\w.]+)", str(wi.message))]
-                if m})
+            rec["fallbacks"] = sorted(set(rec["fallbacks"]) | set(_scrape_fallbacks(wb)))
             g = grads.get(tname)
             if g is None:
                 rec["status"] = f"bwd: no grad entry for {tname}"

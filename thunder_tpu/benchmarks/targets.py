@@ -219,6 +219,184 @@ def bench_vit(rng):
     return _timeit(tm, x, iters=5)
 
 
+@register("llama2_7b_attention")
+def bench_llama2_7b_attention(rng):
+    """One Llama-2-7B attention layer at full dims (reference targets.py
+    llama2 7B attention target)."""
+    from thunder_tpu.models.litgpt import CausalSelfAttention, Config, build_rope_cache
+
+    cfg = Config.from_name("Llama-2-7b-hf", block_size=2048)
+    attn = CausalSelfAttention(cfg, dtype=jnp.bfloat16)
+    tm = _jit(attn)
+    x = _tensor(rng, (1, 2048, cfg.n_embd))
+    cos, sin = build_rope_cache(2048, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+    return _timeit(tm, x, cos, sin, iters=5)
+
+
+@register("llama_mlp_7b")
+def bench_llama_mlp_7b(rng):
+    from thunder_tpu.models.litgpt import Config, LLaMAMLP
+
+    cfg = Config.from_name("Llama-2-7b-hf")
+    mlp = LLaMAMLP(cfg, dtype=jnp.bfloat16)
+    tm = _jit(mlp)
+    x = _tensor(rng, (1, 2048, cfg.n_embd))
+    return _timeit(tm, x, iters=5)
+
+
+@register("gpt2_xl_block")
+def bench_gpt2_xl_block(rng):
+    """GPT-2 XL dims block fwd (reference nanogpt/gpt2-xl family)."""
+    from thunder_tpu.models.litgpt import Block, Config, build_rope_cache
+
+    cfg = Config.from_name("nanogpt-124m", n_embd=1600, n_head=25, block_size=1024)
+    blk = Block(cfg, dtype=jnp.bfloat16)
+    tm = _jit(blk)
+    x = _tensor(rng, (4, 1024, 1600))
+    cos, sin = build_rope_cache(1024, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+    return _timeit(tm, x, cos, sin, iters=5)
+
+
+@register("hf_gpt2_module")
+def bench_hf_gpt2(rng):
+    """HF GPT-2 through the torch interop frontend (reference
+    test_hf_transformers benchmark family)."""
+    try:
+        import torch
+        from transformers import GPT2Config, GPT2LMHeadModel
+    except Exception:
+        return float("nan")
+    cfg = GPT2Config(n_layer=4, n_head=8, n_embd=512, vocab_size=50257,
+                     n_positions=512, use_cache=False)
+    torch.manual_seed(0)
+    import thunder_tpu as tt
+
+    model = GPT2LMHeadModel(cfg).eval()
+    ctm = tt.jit(model)
+    ids = jnp.asarray(rng.randint(0, 50257, (4, 512)), jnp.int32)
+
+    def run(i):
+        out = ctm(input_ids=i, use_cache=False)
+        return out["logits"] if isinstance(out, dict) else out[0]
+
+    return _timeit(run, ids, iters=5)
+
+
+@register("hf_llama_module")
+def bench_hf_llama(rng):
+    try:
+        import torch
+        from transformers import LlamaConfig, LlamaForCausalLM
+    except Exception:
+        return float("nan")
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=512, intermediate_size=1376,
+                      num_hidden_layers=4, num_attention_heads=8,
+                      num_key_value_heads=8, use_cache=False,
+                      max_position_embeddings=1024)
+    torch.manual_seed(0)
+    import thunder_tpu as tt
+
+    model = LlamaForCausalLM(cfg).eval()
+    ctm = tt.jit(model)
+    ids = jnp.asarray(rng.randint(0, 32000, (2, 512)), jnp.int32)
+
+    def run(i):
+        out = ctm(input_ids=i)
+        return out["logits"] if isinstance(out, dict) else out[0]
+
+    return _timeit(run, ids, iters=5)
+
+
+@register("adamw_update_124m")
+def bench_adamw_update(rng):
+    """Fused AdamW over a 124M-param tree — isolates the optimizer fusion
+    cost seen in the llama-350m profile. Absolute numbers on the axon tunnel
+    include per-call dispatch overhead (~50 ms); inside a TrainStep the
+    update fuses into the one whole-step program."""
+    from thunder_tpu import optim
+
+    # few large tensors: per-arg dispatch marshaling on the tunnel would
+    # otherwise dominate (the real step passes params as one fused program)
+    shapes = [(50304, 768)] + [(12, 768, 3072)] + [(12, 3072, 768)] + [(48, 768, 768)]
+    params = {f"p{i}": _tensor(rng, s, jnp.float32) for i, s in enumerate(shapes)}
+    grads = {k: _tensor(rng, v.shape, jnp.float32) for k, v in params.items()}
+    opt = optim.AdamW(lr=1e-4)
+    state = opt.init(params)
+    # no donation: the bench reuses the same buffers every iteration
+    step = jax.jit(opt.update)
+
+    def run(p, g, st):
+        newp, newst = step(p, g, st)
+        return newp["p0"]
+
+    return _timeit(run, params, grads, state, iters=10)
+
+
+@register("embedding_lmhead")
+def bench_embedding_lmhead(rng):
+    """Embedding gather + LM-head matmul + fused xent — the vocab-bound tail
+    of every LM step."""
+    V, D, N = 32000, 1024, 8192
+    wte = _tensor(rng, (V, D))
+    ids = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
+
+    def fn(wte, ids, tgt):
+        h = ltorch.embedding(ids, wte)
+        logits = ltorch.matmul(h, ltorch.transpose(wte, 0, 1))
+        return ltorch.cross_entropy(logits, tgt)
+
+    cf = _jit(fn)
+    return _timeit(cf, wte, ids, tgt, iters=5)
+
+
+@register("layer_norm_bwd")
+def bench_layer_norm_bwd(rng):
+    import thunder_tpu as tt
+
+    x = _tensor(rng, (8192, 1024), jnp.float32)
+    w = _tensor(rng, (1024,), jnp.float32)
+    b = _tensor(rng, (1024,), jnp.float32)
+
+    def loss(x, w, b):
+        return ltorch.sum(ltorch.layer_norm(x, (1024,), w, b))
+
+    vag = tt.value_and_grad(loss)
+    vag(x, w, b)
+
+    def run(x, w, b):
+        return vag(x, w, b)[0]
+
+    return _timeit(run, x, w, b, iters=10)
+
+
+@register("rmsnorm_bwd")
+def bench_rmsnorm_bwd(rng):
+    import thunder_tpu as tt
+
+    x = _tensor(rng, (8192, 1024), jnp.float32)
+    w = _tensor(rng, (1024,), jnp.float32)
+
+    def loss(x, w):
+        return ltorch.sum(ltorch.rms_norm(x, (1024,), w))
+
+    vag = tt.value_and_grad(loss)
+    vag(x, w)
+    return _timeit(lambda: vag(x, w)[0], iters=10)
+
+
+@register("deepseek_moe_router")
+def bench_deepseek_moe(rng):
+    """Larger expert count + top-k routing (reference DeepSeek MoE target)."""
+    from thunder_tpu.models.moe import MoEConfig, MoEMLP
+
+    cfg = MoEConfig(n_embd=1024, n_expert=32, n_expert_per_token=4)
+    mlp = MoEMLP(cfg, dtype=jnp.bfloat16)
+    tm = _jit(mlp)
+    x = _tensor(rng, (4, 512, cfg.n_embd))
+    return _timeit(tm, x, iters=5)
+
+
 def main(pattern: str = "", modes=("fused", "opbyop")):
     """Per-target x per-executor matrix with a winner column (reference
     targets.py benchmark CI table)."""

@@ -181,7 +181,9 @@ def _register_builtin_lookasides() -> None:
         # must raise — NOT silently return an elementwise result
         if len(args) == 1 and isinstance(args[0], TensorProxy):
             t = args[0]
-            if t.ndim <= 1:
+            if t.ndim == 0:
+                raise TypeError(f"builtins.{name} of a 0-d tensor (not iterable, as in torch)")
+            if t.ndim == 1:
                 return getattr(_lt(), reduce_name)(t)
             raise InterpreterError(
                 f"builtins.{name} over a {t.ndim}-D tensor compares whole "

@@ -423,6 +423,12 @@ def getitem(a: TensorProxy, key):
     if not isinstance(key, tuple):
         key = (key,)
     def _lower_list(k):
+        if isinstance(k, bool):
+            # numpy/torch treat a scalar bool index as a new size-int(k) dim;
+            # misrouting through the int branch silently returns row 0/1
+            raise NotImplementedError(
+                "scalar boolean indexing (x[True]/x[False]) is not supported; "
+                "use unsqueeze / an explicit empty slice")
         if not (isinstance(k, list) and k):
             return k
         if all(isinstance(e, bool) for e in k):

@@ -135,3 +135,125 @@ def to_dot(trace) -> str:
             producer[p.name] = nid
     lines.append("}")
     return "\n".join(lines)
+
+
+def fusion_report(cfn) -> list[dict]:
+    """Per-fusion statistics: op histogram, input/output tensor bytes, and
+    the claimed pallas/ops inside (the fusion-introspection depth of
+    reference examine/__init__.py:210-311)."""
+    out = []
+    for i, bsym in enumerate(get_fusions(cfn)):
+        sub = getattr(bsym.impl, "subtrace", None)
+        hist: dict[str, int] = {}
+        if sub is not None:
+            for b in sub.bound_symbols:
+                if b.sym.id in _STRUCTURAL:
+                    continue
+                hist[b.sym.name] = hist.get(b.sym.name, 0) + 1
+
+        def _bytes(proxies):
+            total = 0
+            for p in proxies:
+                if hasattr(p, "shape") and hasattr(p, "dtype"):
+                    n = 1
+                    for d in p.shape:
+                        n *= int(d)
+                    total += n * p.dtype.bytes
+            return total
+
+        out.append({
+            "index": i,
+            "name": str(bsym.sym.id),
+            "n_ops": sum(hist.values()),
+            "op_histogram": dict(sorted(hist.items(), key=lambda kv: -kv[1])),
+            "input_bytes": _bytes(bsym.flat_proxy_args()),
+            "output_bytes": _bytes(bsym.flat_proxy_outs()),
+        })
+    return out
+
+
+def model_zoo_coverage(report_path: str | None = None) -> list[dict]:
+    """examine() across the in-repo model zoo — the reference's model
+    coverage reports role (examine over litgpt/nanogpt/ViT/ResNet/MoE)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    rows = []
+
+    def probe(name, build):
+        try:
+            fn, args = build()
+            rep = examine(fn, *args)
+            rows.append({"model": name, "n_ops": rep["n_ops"],
+                         "distinct": len(rep["ops"]),
+                         "unclaimed": rep["unclaimed"], "ok": rep["supported"]})
+        except Exception as e:  # report, don't abort the sweep
+            rows.append({"model": name, "error": f"{type(e).__name__}: {e}"[:200],
+                         "ok": False})
+
+    def _litgpt(cfg_name):
+        def build():
+            from ..models.litgpt import Config, GPTForCausalLM
+
+            cfg = Config.from_name(cfg_name, block_size=64)
+            m = GPTForCausalLM(cfg)
+            i = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+            return m, (i, i)
+
+        return build
+
+    probe("tiny-llama2", _litgpt("tiny-llama2"))
+    probe("tiny-gptneox", _litgpt("tiny-gptneox"))
+
+    def _nanogpt():
+        from ..models.nanogpt import NanoGPT, configs
+
+        m = NanoGPT(configs["test"])
+        i = jnp.asarray(rng.randint(0, 256, (2, 32)), jnp.int32)
+        return m, (i,)
+
+    probe("nanogpt", _nanogpt)
+
+    def _resnet():
+        from ..models.resnet import build
+
+        m = build("resnet18")
+        x = jnp.asarray(rng.randn(1, 3, 32, 32).astype(np.float32))
+        return m, (x,)
+
+    probe("resnet18", _resnet)
+
+    def _vit():
+        from ..models.vit import ViT, configs
+
+        cfg = configs["test"]
+        m = ViT(cfg)
+        x = jnp.asarray(rng.randn(1, 3, cfg.image_size, cfg.image_size).astype(np.float32))
+        return m, (x,)
+
+    probe("vit", _vit)
+
+    def _moe():
+        from ..models.moe import MoEConfig, MoEMLP
+
+        cfg = MoEConfig(n_embd=32, n_expert=4, n_expert_per_token=2)
+        m = MoEMLP(cfg)
+        x = jnp.asarray(rng.randn(2, 8, 32).astype(np.float32))
+        return m, (x,)
+
+    probe("moe_mlp", _moe)
+
+    if report_path:
+        lines = ["# Model-zoo op coverage (examine sweep)", "",
+                 "| model | ops | distinct | unclaimed | ok |", "|---|---|---|---|---|"]
+        for r in rows:
+            if "error" in r:
+                lines.append(f"| {r['model']} | — | — | {r['error']} | ✗ |")
+            else:
+                un = ", ".join(r["unclaimed"]) or "none"
+                lines.append(f"| {r['model']} | {r['n_ops']} | {r['distinct']} | {un} "
+                             f"| {'✓' if r['ok'] else '✗'} |")
+        with open(report_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return rows
