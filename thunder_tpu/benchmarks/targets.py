@@ -269,8 +269,6 @@ def bench_hf_gpt2(rng):
     cfg = GPT2Config(n_layer=4, n_head=8, n_embd=512, vocab_size=50257,
                      n_positions=512, use_cache=False)
     torch.manual_seed(0)
-    import thunder_tpu as tt
-
     model = GPT2LMHeadModel(cfg).eval()
     ctm = tt.jit(model)
     ids = jnp.asarray(rng.randint(0, 50257, (4, 512)), jnp.int32)
@@ -294,8 +292,6 @@ def bench_hf_llama(rng):
                       num_key_value_heads=8, use_cache=False,
                       max_position_embeddings=1024)
     torch.manual_seed(0)
-    import thunder_tpu as tt
-
     model = LlamaForCausalLM(cfg).eval()
     ctm = tt.jit(model)
     ids = jnp.asarray(rng.randint(0, 32000, (2, 512)), jnp.int32)
@@ -352,8 +348,6 @@ def bench_embedding_lmhead(rng):
 
 @register("layer_norm_bwd")
 def bench_layer_norm_bwd(rng):
-    import thunder_tpu as tt
-
     x = _tensor(rng, (8192, 1024), jnp.float32)
     w = _tensor(rng, (1024,), jnp.float32)
     b = _tensor(rng, (1024,), jnp.float32)
@@ -372,8 +366,6 @@ def bench_layer_norm_bwd(rng):
 
 @register("rmsnorm_bwd")
 def bench_rmsnorm_bwd(rng):
-    import thunder_tpu as tt
-
     x = _tensor(rng, (8192, 1024), jnp.float32)
     w = _tensor(rng, (1024,), jnp.float32)
 

@@ -184,7 +184,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
     ki = pl.program_id(2)
     k_blk = k_ref[:]
     v_blk = v_ref[:]
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    # work in the TRANSPOSED orientation (rows = k positions): every dot then
+    # contracts lhs dim 1 against rhs dim 0/1 naturally — the straight
+    # orientation needs pᵀ/dsᵀ for dv/dk, and those in-kernel transposes of
+    # (block_q, block_k) tiles cost more than the matmuls themselves
+    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -192,18 +196,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         do = do_ref[pl.ds(i * block_q, block_q), :]
         lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0]
         delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32) * scale  # (bk, bq)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dv_acc = dv_acc + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse[None, :])
+        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                              (((1,), (0,)), ((), ())),
                                               preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)  # (bk, bq)
+        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
                                               preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 

@@ -913,7 +913,13 @@ def _register_cross_entropy_grad():
         m = clang.amax(lg, 1, keepdim=True)
         lse = clang.add(prims.log(clang.sum_(prims.exp(clang.sub(lg, m)), 1, keepdim=True)), m)
         tgt2 = clang.unsqueeze(target, 1)
-        picked = clang.take_along_axis(lg, tgt2, 1)
+        # gather from the ORIGINAL-dtype logits and upcast the picked values
+        # (exact for bf16→f32): a gather consuming lg forces the full f32
+        # (N, vocab) convert to materialize as a fusion output — a 1 GB HBM
+        # round-trip per step at llama vocab sizes — while the reduction
+        # chain over lg alone fuses into one pass
+        picked = clang.maybe_convert_to_dtype(
+            clang.take_along_axis(logits, tgt2, 1), dtypes.float32)
         nll = clang.squeeze(clang.sub(lse, picked), 1)
         if label_smoothing > 0.0:
             # smooth term: -mean(log_softmax) = lse - mean(logits)

@@ -142,6 +142,16 @@ def fusion_report(cfn) -> list[dict]:
     the claimed pallas/ops inside (the fusion-introspection depth of
     reference examine/__init__.py:210-311)."""
     out = []
+    def _bytes(proxies):
+        total = 0
+        for p in proxies:
+            if hasattr(p, "shape") and hasattr(p, "dtype"):
+                n = 1
+                for d in p.shape:
+                    n *= int(d)
+                total += n * p.dtype.bytes
+        return total
+
     for i, bsym in enumerate(get_fusions(cfn)):
         sub = getattr(bsym.impl, "subtrace", None)
         hist: dict[str, int] = {}
@@ -150,16 +160,6 @@ def fusion_report(cfn) -> list[dict]:
                 if b.sym.id in _STRUCTURAL:
                     continue
                 hist[b.sym.name] = hist.get(b.sym.name, 0) + 1
-
-        def _bytes(proxies):
-            total = 0
-            for p in proxies:
-                if hasattr(p, "shape") and hasattr(p, "dtype"):
-                    n = 1
-                    for d in p.shape:
-                        n *= int(d)
-                    total += n * p.dtype.bytes
-            return total
 
         out.append({
             "index": i,
@@ -249,7 +249,8 @@ def model_zoo_coverage(report_path: str | None = None) -> list[dict]:
                  "| model | ops | distinct | unclaimed | ok |", "|---|---|---|---|---|"]
         for r in rows:
             if "error" in r:
-                lines.append(f"| {r['model']} | — | — | {r['error']} | ✗ |")
+                err = r["error"].replace("|", "\\|")
+                lines.append(f"| {r['model']} | — | — | error: {err} | ✗ |")
             else:
                 un = ", ".join(r["unclaimed"]) or "none"
                 lines.append(f"| {r['model']} | {r['n_ops']} | {r['distinct']} | {un} "
