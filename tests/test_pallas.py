@@ -157,3 +157,47 @@ def test_sdpa_gqa_falls_back_to_composite(rng):
     # without enable_gqa, mismatched heads is an error (torch semantics)
     with pytest.raises(RuntimeError, match="enable_gqa"):
         tt.jit(lambda q, k, v: ltorch.sdpa(q, k, v))(q, k, v)
+
+
+def test_rope_sdpa_fused_matches_decomposition(rng):
+    """Fused rope+flash (in-kernel rope + in-kernel rope-VJP rotation) vs the
+    decomposed rope->sdpa path, fwd and grads (f32, interpret mode)."""
+    import math
+
+    import thunder_tpu as tt
+    from thunder_tpu.models.litgpt import build_rope_cache
+
+    B, H, T, D = 1, 2, 1024, 64  # T=1024: the fused kernel actually claims
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32)) for _ in range(3))
+    cos, sin = build_rope_cache(T, D, 10000, jnp.float32)
+
+    calls = {"n": 0}
+    orig_fwd = pallasex.flash_rope_attention_forward
+
+    def spy(*a, **kw):
+        calls["n"] += 1
+        return orig_fwd(*a, **kw)
+
+    pallasex.flash_rope_attention_forward = spy
+
+    def loss(q, k, v, c, s):
+        return ltorch.sum(ltorch.rope_sdpa(q, k, v, c, s, is_causal=True,
+                                           scale=1.0 / math.sqrt(D)))
+
+    import thunder_tpu.executors.pallasex as px
+
+    orig = px.rope_sdpa_supported
+    px.rope_sdpa_supported = lambda *a, **kw: False
+    try:
+        ref_loss, ref_g = tt.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v, cos, sin)
+    finally:
+        px.rope_sdpa_supported = orig
+    try:
+        got_loss, got_g = tt.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v, cos, sin)
+    finally:
+        pallasex.flash_rope_attention_forward = orig_fwd
+    assert calls["n"] >= 1, "fused rope kernel was not exercised"
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-6)
+    for i, name in enumerate(["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(got_g[0][i]), np.asarray(ref_g[0][i]),
+                                   atol=1e-4, err_msg=name)

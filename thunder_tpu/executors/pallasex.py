@@ -270,6 +270,334 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     return dq, dk, dv
 
 
+# ===========================================================================
+# Fused RoPE + flash attention (rope applied in-kernel; pre-rope q/k are the
+# saved-for-backward residuals and the rope VJP rotation happens in-kernel
+# on the dq/dk accumulators — the separate rope slice/negate/cat fusions and
+# their backward passes disappear from the XLA timeline)
+# ===========================================================================
+
+
+def _rot_matrix(D: int, dtype):
+    """rotate_half as a constant matmul: rotate(x) = x @ R with
+    R[i, j] = -1 at i == j + D/2, +1 at i == j - D/2. Lane-slicing halves of
+    a bf16 tile in-kernel lowers to catastrophic VREG shuffles on Mosaic;
+    one (N, D) @ (D, D) dot is MXU-trivial instead."""
+    h = D // 2
+    ii = jax.lax.broadcasted_iota(jnp.int32, (D, D), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (D, D), 1)
+    r = jnp.where(ii == jj + h, -1.0, 0.0) + jnp.where(ii + h == jj, 1.0, 0.0)
+    return r.astype(dtype)
+
+
+def _rope_block(x, c, s):
+    """x (N, D) f32 -> rope'd (N, D); cos/sin (N, D) duplicated-half caches."""
+    rot = jax.lax.dot_general(x, _rot_matrix(x.shape[-1], x.dtype),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return x * c + rot * s
+
+
+def _rope_vjp_block(dxr, c, s):
+    """VJP of _rope_block wrt x: dx = dxr*c + (dxr*s) @ R^T."""
+    ds = dxr * s
+    rot = jax.lax.dot_general(ds, _rot_matrix(dxr.shape[-1], ds.dtype),
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return dxr * c + rot
+
+
+def _flash_rope_fwd_kernel(q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+                           o_ref, lse_ref, *, block_k: int, causal: bool, scale: float):
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    qi = pl.program_id(2)
+
+    q = _rope_block(q_ref[:].astype(jnp.float32), cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        o_acc, m, l = carry
+        k_blk = _rope_block(k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32),
+                            ck_ref[pl.ds(j * block_k, block_k), :],
+                            sk_ref[pl.ds(j * block_k, block_k), :]).astype(k_ref.dtype)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        ss = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            ss = jnp.where(k_pos <= q_pos, ss, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(ss, axis=1))
+        pp = jnp.exp(ss - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(pp, axis=1)
+        o_new = o_acc * corr[:, None] + jax.lax.dot_general(
+            pp.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    n_k = T // block_k
+    if causal:
+        n_k = jnp.minimum(n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_k, body, (o0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
+
+
+def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scale=None,
+                                 block_q: int = DEFAULT_BLOCK_Q,
+                                 block_k: int = DEFAULT_BLOCK_K):
+    """q,k,v PRE-rope (B, H, T, D); cos/sin (T, D) duplicated-half caches."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    cos = cos.astype(jnp.float32)
+    sin = sin.astype(jnp.float32)
+    o, lse = pl.pallas_call(
+        functools.partial(_flash_rope_fwd_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=(B, H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((T, D), lambda b, h, i: (0, 0)),
+            pl.BlockSpec((T, D), lambda b, h, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, cos, sin, cos, sin)
+    return o, lse[..., 0]
+
+
+def _flash_rope_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                              cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, *,
+                              block_k: int, causal: bool, scale: float):
+    block_q, D = q_ref.shape
+    T = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = _rope_block(q_ref[:].astype(jnp.float32), cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
+    do = do_ref[:]
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq_acc):
+        k_blk = _rope_block(k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32),
+                            ck_ref[pl.ds(j * block_k, block_k), :],
+                            sk_ref[pl.ds(j * block_k, block_k), :]).astype(k_ref.dtype)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        ss = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            ss = jnp.where(k_pos <= q_pos, ss, NEG_INF)
+        pp = jnp.exp(ss - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = pp * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                            (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+
+    n_k = T // block_k
+    if causal:
+        n_k = jnp.minimum(n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+    dq_r = jax.lax.fori_loop(0, n_k, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[:] = _rope_vjp_block(dq_r, cq_ref[:], sq_ref[:]).astype(dq_ref.dtype)
+
+
+def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                               cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref, *,
+                               block_q: int, causal: bool, scale: float):
+    block_k, D = k_ref.shape
+    T = q_ref.shape[0]
+    ki = pl.program_id(2)
+    k_blk = _rope_block(k_ref[:].astype(jnp.float32), ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
+    v_blk = v_ref[:]
+    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = _rope_block(q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32),
+                        cq_ref[pl.ds(i * block_q, block_q), :],
+                        sq_ref[pl.ds(i * block_q, block_q), :]).astype(q_ref.dtype)
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse[None, :])
+        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                              (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    i0 = (ki * block_k) // block_q if causal else 0
+    dk_r, dv = jax.lax.fori_loop(i0, T // block_q, body, (z, z))
+    dk_ref[:] = _rope_vjp_block(dk_r, ck_ref[:], sk_ref[:]).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool = True,
+                                  scale=None, block_q: int = DEFAULT_BLOCK_Q,
+                                  block_k: int = DEFAULT_BLOCK_K):
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    cos = cos.astype(jnp.float32)
+    sin = sin.astype(jnp.float32)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_rope_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=(B, H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
+            pl.BlockSpec((T, D), lambda b, h, i: (0, 0)),
+            pl.BlockSpec((T, D), lambda b, h, i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_rope_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        grid=(B, H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
+            pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
+            pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
+            pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, T, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+    return dq, dk, dv
+
+
+def rope_sdpa_supported(q, k, v, cos, sin, is_causal=True, scale=None) -> bool:
+    """Claim fused rope+attention when the plain flash checker would claim
+    the sdpa AND rope covers the full (even) head dim."""
+    if getattr(q, "ndim", 0) != 4:
+        return False
+    D = q.shape[-1]
+    T = q.shape[-2]
+    return (
+        flash_attention_supported(q, k, v, None, 0.0, is_causal, scale)
+        and D % 2 == 0
+        and getattr(cos, "shape", None) == (T, D)
+        and getattr(sin, "shape", None) == (T, D)
+    )
+
+
+def _rope_sdpa_impl(q, k, v, cos, sin, is_causal=True, scale=None):
+    o, _ = flash_rope_attention_forward(q, k, v, cos, sin, causal=is_causal, scale=scale)
+    return o
+
+
+_rope_sdpa_jitted = jax.jit(_rope_sdpa_impl, static_argnames=("is_causal", "scale"))
+
+
+def _rope_sdpa_claimed(q, k, v, cos, sin, is_causal=True, scale=None):
+    # jit wrapper: a claimed op dispatched standalone (outside a fusion
+    # region) would otherwise re-lower the pallas_call on every invocation
+    try:
+        return _rope_sdpa_jitted(q, k, v, cos, sin,
+                                 is_causal=bool(is_causal),
+                                 scale=None if scale is None else float(scale))
+    except (TypeError, jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        return _rope_sdpa_impl(q, k, v, cos, sin, is_causal=is_causal, scale=scale)
+
+
+def _register_rope_sdpa():
+    from ..ops.ltorch import rope_sdpa as _rope_sdpa_sym
+
+    ex.register_implementation(_rope_sdpa_sym.id, _rope_sdpa_claimed,
+                               checker=rope_sdpa_supported)
+
+    fwd_sym = ex.register_operator(
+        "rope_flash_fwd",
+        meta=lambda q, k, v, cos, sin, causal, scale: (
+            TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device),
+            TensorProxy(shape=q.shape[:-1], dtype=dtypes.float32, device=q.device),
+        ),
+        fn=lambda q, k, v, cos, sin, causal, scale: flash_rope_attention_forward(
+            q, k, v, cos, sin, causal=causal, scale=scale),
+    )
+    bwd_sym = ex.register_operator(
+        "rope_flash_bwd",
+        meta=lambda q, k, v, o, lse, cos, sin, causal, scale, do: (
+            TensorProxy(shape=q.shape, dtype=q.dtype, device=q.device),
+            TensorProxy(shape=k.shape, dtype=k.dtype, device=k.device),
+            TensorProxy(shape=v.shape, dtype=v.dtype, device=v.device),
+        ),
+        fn=lambda q, k, v, o, lse, cos, sin, causal, scale, do: flash_rope_attention_backward(
+            q, k, v, o, lse, cos, sin, do, causal=causal, scale=scale),
+    )
+
+    from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+    @register_augmented_forward(_rope_sdpa_sym.id)
+    def _rope_sdpa_aug(q, k, v, cos, sin, is_causal=True, scale=None):
+        if not rope_sdpa_supported(q, k, v, cos, sin, is_causal, scale):
+            return NotImplemented  # decompose: composite rope + sdpa rules apply
+        o, lse = fwd_sym(q, k, v, cos, sin, bool(is_causal), scale)
+        return VJPResult(o, (q, k, v, o, lse, cos, sin, bool(is_causal), scale))
+
+    @register_backward(_rope_sdpa_sym.id)
+    def _rope_sdpa_bwd(q, k, v, o, lse, cos, sin, causal, scale, g):
+        dq, dk, dv = bwd_sym(q, k, v, o, lse, cos, sin, causal, scale, g)
+        return dq, dk, dv, None, None, None, None
+
+
 def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False) -> bool:
     """Checker: pallas flash attention claims sdpa when shapes fit the tiling."""
     if attn_mask is not None or (dropout_p and dropout_p > 0.0):
@@ -513,3 +841,6 @@ ex.register_implementation(
     _rms_claimed,
     checker=_rms_supported,
 )
+
+
+_register_rope_sdpa()

@@ -171,14 +171,23 @@ class CausalSelfAttention(nn.Module):
         v = ltorch.permute(v, (0, 2, 1, 3))
 
         n_elem = cfg.rope_n_elem
-        q = _apply_rope(q, cos, sin, n_elem)
-        k = _apply_rope(k, cos, sin, n_elem)
+        from ..parallel.context_parallel import current_seq_parallel_ctx
 
-        if ng != nh:
-            k = _repeat_kv(k, q_per_kv)
-            v = _repeat_kv(v, q_per_kv)
-
-        y = ltorch.sdpa(q, k, v, is_causal=True, scale=1.0 / math.sqrt(hs))
+        if (ng == nh and n_elem == hs and hs % 2 == 0
+                and current_seq_parallel_ctx() is None):
+            # fused rope+attention symbol: the pallas executor applies rope
+            # in-kernel (and rotates the rope VJP in-kernel in backward);
+            # ring-attention CP rewrites plain sdpa bsyms, so it keeps the
+            # decomposed path
+            y = ltorch.rope_sdpa(q, k, v, cos, sin, is_causal=True,
+                                 scale=1.0 / math.sqrt(hs))
+        else:
+            q = _apply_rope(q, cos, sin, n_elem)
+            k = _apply_rope(k, cos, sin, n_elem)
+            if ng != nh:
+                k = _repeat_kv(k, q_per_kv)
+                v = _repeat_kv(v, q_per_kv)
+            y = ltorch.sdpa(q, k, v, is_causal=True, scale=1.0 / math.sqrt(hs))
         y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * hs))
         return self.proj(y)
 
@@ -192,17 +201,24 @@ def _repeat_kv(x, n: int):
 
 
 def _apply_rope(x, cos, sin, n_elem: int):
+    """Half-split RoPE. Structured as half-width muls with ONE final concat:
+    the cat([-x2, x1])-then-multiply form pays an extra full-width
+    materialize + awkward slice/negate fusions in XLA (profiled ~16 ms/step
+    on llama-350m); with duplicated-half caches cos[:d/2] == cos[d/2:], so
+    out1 = x1·c − x2·s and out2 = x2·c + x1·s need no concat until the end."""
     if n_elem <= 0:
         return x
     hs = x.shape[-1]
-    rot = x[..., :n_elem]
-    x1 = rot[..., : n_elem // 2]
-    x2 = rot[..., n_elem // 2:]
-    rotated = ltorch.cat([-x2, x1], -1)
-    roped = rot * cos + rotated * sin
+    h = n_elem // 2
+    x1 = x[..., :h]
+    x2 = x[..., h:n_elem]
+    c = cos[..., :h]
+    s = sin[..., :h]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
     if n_elem < hs:
-        return ltorch.cat([roped, x[..., n_elem:]], -1)
-    return roped
+        return ltorch.cat([out1, out2, x[..., n_elem:]], -1)
+    return ltorch.cat([out1, out2], -1)
 
 
 class Block(nn.Module):

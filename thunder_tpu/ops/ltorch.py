@@ -827,6 +827,32 @@ def rms_norm(a, normalized_shape, weight=None, eps=1e-6):
     return out
 
 
+@torchsymbol(name="rope_sdpa", id="thunder.rope_sdpa")
+def rope_sdpa(q, k, v, cos, sin, is_causal=True, scale=None):
+    """Fused half-split RoPE + scaled-dot-product attention.
+
+    q/k arrive PRE-rope; cos/sin are (T, head_dim) duplicated-half caches.
+    The pallas executor claims this whole (rope applied in-kernel, rope VJP
+    rotated in-kernel on the dq/dk accumulators — the separate rope
+    slice/negate/cat fusions and their backward passes disappear). The
+    decomposition below is the unclaimed/CPU path and the grad fallback."""
+    hs = q.shape[-1]
+    h = hs // 2
+
+    def rope(x):
+        x1 = x[..., :h]
+        x2 = x[..., h:]
+        c = cos[..., :h]
+        s_ = sin[..., :h]
+        out = cat([x1 * c - x2 * s_, x2 * c + x1 * s_], -1)
+        # rope math runs f32 (f32 cos/sin promote), but the attention matmuls
+        # must keep the input compute dtype (autocast bf16 would otherwise be
+        # silently undone on the unclaimed path)
+        return clang.maybe_convert_to_dtype(out, x.dtype)
+
+    return sdpa.meta(rope(q), rope(k), v, is_causal=is_causal, scale=scale)
+
+
 @torchsymbol(name="sdpa", id="torch.nn.functional.scaled_dot_product_attention")
 def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
     """Scaled dot-product attention (composite; Pallas flash-attention executor

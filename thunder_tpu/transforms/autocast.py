@@ -62,6 +62,12 @@ class AutocastTransform(Transform):
         to = self.dtype
 
         def visitor(bsym, args, kwargs):
+            if bsym.sym.id == "thunder.rope_sdpa":
+                # cast only q/k/v: the cos/sin caches must stay f32 (bf16
+                # rope angles lose precision at large positions)
+                args = tuple(self._cast(a, to) if i < 3 else a
+                             for i, a in enumerate(args))
+                return bsym.sym(*args, **kwargs)
             if bsym.sym.id in _LOW_PRECISION_IDS:
                 args = tuple(self._cast(a, to) for a in args)
                 kwargs = {k: self._cast(v, to) for k, v in kwargs.items()}
