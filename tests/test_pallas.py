@@ -76,10 +76,12 @@ def test_checker_accepts_gpt2_shapes():
     # unaligned sequence length stays on the composite path
     q_bad = FakeProxy((8, 12, 4100, 64))
     assert not pallasex.flash_attention_supported(q_bad, q_bad, q_bad, None, 0.0, True, None)
-    # GQA/MQA (fewer k/v heads) must fall back: the kernel grid indexes k/v
-    # blocks by q's head id
+    # GQA/MQA (divisible kv heads) now claims: kv blocks index h // group,
+    # dkv group-sums per-q-head partials
     kv = FakeProxy((2, 4, 4096, 64))
-    assert not pallasex.flash_attention_supported(q, kv, kv, None, 0.0, True, None)
+    assert pallasex.flash_attention_supported(q, kv, kv, None, 0.0, True, None)
+    kv_bad = FakeProxy((2, 5, 4096, 64))  # indivisible head count: composite
+    assert not pallasex.flash_attention_supported(q, kv_bad, kv_bad, None, 0.0, True, None)
     # mismatched head dim / kv seq len also fall back
     v_bad = FakeProxy((2, 12, 4096, 128))
     assert not pallasex.flash_attention_supported(q, q, v_bad, None, 0.0, True, None)
@@ -127,9 +129,10 @@ def test_fused_rms_norm_matches(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
 
 
-def test_sdpa_gqa_falls_back_to_composite(rng):
-    """GQA shapes must not be claimed by the flash kernel (its grid indexes
-    k/v blocks by q's head id); the composite path replicates kv heads."""
+def test_sdpa_gqa_short_seq_falls_back_to_composite(rng):
+    """GQA now CAN claim (kv head = q head // group in the BlockSpecs), but
+    this T=256 case fails the size gate (T>=1024, block divisibility) like
+    any short sequence — the composite path replicates kv heads."""
     B, Hq, Hkv, T, D = 2, 8, 2, 256, 64
     q = jnp.asarray(rng.randn(B, Hq, T, D).astype(np.float32))
     k = jnp.asarray(rng.randn(B, Hkv, T, D).astype(np.float32))
@@ -201,3 +204,27 @@ def test_rope_sdpa_fused_matches_decomposition(rng):
     for i, name in enumerate(["dq", "dk", "dv"]):
         np.testing.assert_allclose(np.asarray(got_g[0][i]), np.asarray(ref_g[0][i]),
                                    atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("dtype,atol", [(np.float32, 2e-3)])
+def test_flash_gqa_matches_reference(rng, dtype, atol):
+    """GQA flash: kv head = q head // group in the BlockSpecs; dkv backward
+    group-sums per-q-head partials (no repeated-KV materialization)."""
+    B, Hq, Hkv, T, D = 2, 8, 2, 256, 64
+    q = jnp.asarray(rng.randn(B, Hq, T, D).astype(dtype))
+    k = jnp.asarray(rng.randn(B, Hkv, T, D).astype(dtype))
+    v = jnp.asarray(rng.randn(B, Hkv, T, D).astype(dtype))
+    o, lse = pallasex.flash_attention_forward(q, k, v, causal=True)
+    kk = jnp.repeat(k, Hq // Hkv, axis=1)
+    vv = jnp.repeat(v, Hq // Hkv, axis=1)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(_ref_attn(q, kk, vv)), atol=atol)
+
+    do = jnp.asarray(rng.randn(*o.shape).astype(dtype))
+    dq, dk, dv = pallasex.flash_attention_backward(q, k, v, o, lse, do, causal=True)
+    assert dk.shape == k.shape and dv.shape == v.shape
+    ref = jax.vjp(lambda q, k, v: _ref_attn(
+        q, jnp.repeat(k, Hq // Hkv, axis=1), jnp.repeat(v, Hq // Hkv, axis=1)),
+        q, k, v)[1](do)
+    for got, want, name in zip((dq, dk, dv), ref, "dq dk dv".split()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-3,
+                                   err_msg=name)

@@ -112,6 +112,8 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     B, H, T, D = q.shape
     Tk = k.shape[2]
+    Hkv = k.shape[1]
+    g = H // Hkv  # GQA group: kv head = q head // g (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
     grid = (B, H, T // block_q)
@@ -122,8 +124,8 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h // g, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
@@ -224,8 +226,15 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     B, H, T, D = q.shape
     Tk = k.shape[2]
+    Hkv = k.shape[1]
+    g = H // Hkv  # GQA: dk/dv computed per q head, group-summed below
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
+    if g > 1:
+        # grouped-kv double buffering vmem guard; gcd keeps divisibility
+        # under TT_FLASH_BLOCK_* overrides (a non-divisor block would
+        # silently truncate the dkv grid)
+        block_k = math.gcd(min(block_k, 512), Tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (B,H,T)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
@@ -235,8 +244,8 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         grid=(B, H, T // block_q),
         in_specs=[
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i: (b, h // g, 0, 0)),
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
@@ -251,8 +260,8 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         grid=(B, H, Tk // block_k),
         in_specs=[
             pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
             pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
@@ -267,6 +276,11 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
+    if g > 1:
+        # per-q-head partials -> per-kv-head grads (the dkv grid runs over q
+        # heads; writing shared kv outputs from grouped programs would race)
+        dk = jnp.sum(dk.reshape(B, Hkv, g, Tk, D), axis=2)
+        dv = jnp.sum(dv.reshape(B, Hkv, g, Tk, D), axis=2)
     return dq, dk, dv
 
 
@@ -354,6 +368,8 @@ def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scal
     """q,k,v PRE-rope (B, H, T, D); cos/sin (T, D) duplicated-half caches."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv  # GQA group (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     cos = cos.astype(jnp.float32)
@@ -363,8 +379,8 @@ def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scal
         grid=(B, H, T // block_q),
         in_specs=[
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h // g, 0, 0)),
             pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
             pl.BlockSpec((block_q, D), lambda b, h, i: (i, 0)),
             pl.BlockSpec((T, D), lambda b, h, i: (0, 0)),
@@ -466,8 +482,16 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
                                   block_k: int = DEFAULT_BLOCK_K):
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv  # GQA: dk/dv per-q-head partials group-summed at the end
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    if g > 1:
+        # grouped kv blocks are revisited across q-head programs; Mosaic's
+        # double-buffering pushes the 1024-row block ~160K over the 16M
+        # scoped-vmem limit — halve the k block for GQA (gcd: stay a divisor
+        # of T under TT_FLASH_BLOCK_* overrides)
+        block_k = math.gcd(min(block_k, 512), T)
     cos = cos.astype(jnp.float32)
     sin = sin.astype(jnp.float32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -479,8 +503,8 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         grid=(B, H, T // block_q),
         in_specs=[
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, h, i: (b, h // g, 0, 0)),
             pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, 1), lambda b, h, i: (b, h, i, 0)),
@@ -499,8 +523,8 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         grid=(B, H, T // block_k),
         in_specs=[
             pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
             pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
             pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
@@ -519,6 +543,9 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+    if g > 1:
+        dk = jnp.sum(dk.reshape(B, Hkv, g, T, D), axis=2)
+        dv = jnp.sum(dv.reshape(B, Hkv, g, T, D), axis=2)
     return dq, dk, dv
 
 
@@ -542,19 +569,29 @@ def _rope_sdpa_impl(q, k, v, cos, sin, is_causal=True, scale=None):
     return o
 
 
-_rope_sdpa_jitted = jax.jit(_rope_sdpa_impl, static_argnames=("is_causal", "scale"))
+def _jit_claimed(impl, static_argnames, normalize):
+    """Shared jit wrapper for claimed ops dispatched standalone (outside a
+    fusion region they would otherwise re-lower the pallas_call on every
+    invocation). `normalize` maps the call args to hashable statics; any
+    tracer-in-static slips through to the unjitted impl."""
+    jitted = jax.jit(impl, static_argnames=static_argnames)
+
+    def claimed(*args, **kwargs):
+        try:
+            a, kw = normalize(*args, **kwargs)
+            return jitted(*a, **kw)
+        except (TypeError, jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            return impl(*args, **kwargs)
+
+    return claimed
 
 
-def _rope_sdpa_claimed(q, k, v, cos, sin, is_causal=True, scale=None):
-    # jit wrapper: a claimed op dispatched standalone (outside a fusion
-    # region) would otherwise re-lower the pallas_call on every invocation
-    try:
-        return _rope_sdpa_jitted(q, k, v, cos, sin,
-                                 is_causal=bool(is_causal),
-                                 scale=None if scale is None else float(scale))
-    except (TypeError, jax.errors.TracerArrayConversionError,
-            jax.errors.ConcretizationTypeError):
-        return _rope_sdpa_impl(q, k, v, cos, sin, is_causal=is_causal, scale=scale)
+_rope_sdpa_claimed = _jit_claimed(
+    _rope_sdpa_impl, ("is_causal", "scale"),
+    lambda q, k, v, cos, sin, is_causal=True, scale=None: (
+        (q, k, v, cos, sin),
+        {"is_causal": bool(is_causal), "scale": None if scale is None else float(scale)}))
 
 
 def _register_rope_sdpa():
@@ -622,10 +659,12 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=
         and q.shape[-2] % DEFAULT_BLOCK_Q == 0
         and k.shape[-2] % DEFAULT_BLOCK_K == 0
         and q.shape[-2] == k.shape[-2]
-        # The kernel grid is (B, q_heads, ...) and k/v BlockSpecs index by q's
-        # head id, so GQA/MQA (fewer k/v heads) or mismatched batch/head-dim
-        # shapes must stay on the composite sdpa path.
-        and q.shape[:2] == k.shape[:2] == v.shape[:2]
+        # GQA/MQA: the k/v BlockSpecs index kv head = q head // group, and
+        # the dkv backward computes per-q-head partials group-summed outside
+        # (shared kv outputs written from grouped programs would race)
+        and q.shape[0] == k.shape[0] == v.shape[0]
+        and k.shape[1] == v.shape[1]
+        and q.shape[1] % k.shape[1] == 0
         and q.shape[-1] == k.shape[-1] == v.shape[-1]
         and k.shape[-2] == v.shape[-2]
     )
@@ -640,20 +679,12 @@ def _sdpa_flash_impl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, sc
     return o
 
 
-# jit-wrapped at registration: a claimed op dispatched standalone (outside a
-# fusion region) would otherwise re-lower the pallas_call on every invocation.
-# Each wrapper normalizes static args to hashables and falls back to the
-# unjitted impl if a static arg turns out to be a tracer.
-_sdpa_jitted = jax.jit(_sdpa_flash_impl, static_argnames=("dropout_p", "is_causal", "scale"))
-
-
-def _sdpa_claimed(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
-    try:
-        return _sdpa_jitted(q, k, v, attn_mask,
-                            float(dropout_p), bool(is_causal),
-                            None if scale is None else float(scale))
-    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
-        return _sdpa_flash_impl(q, k, v, attn_mask, dropout_p, is_causal, scale)
+_sdpa_claimed = _jit_claimed(
+    _sdpa_flash_impl, ("dropout_p", "is_causal", "scale"),
+    lambda q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None,
+    enable_gqa=False: (
+        (q, k, v, attn_mask, float(dropout_p), bool(is_causal),
+         None if scale is None else float(scale)), {}))
 
 
 ex.register_implementation(
@@ -765,15 +796,12 @@ def _xent_impl(logits, target, weight=None, ignore_index=-100, reduction="mean",
     return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
 
 
-_xent_jitted = jax.jit(_xent_impl, static_argnames=("ignore_index", "reduction", "label_smoothing"))
-
-
-def _xent_claimed(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
-    try:
-        return _xent_jitted(logits, target, weight,
-                            int(ignore_index), str(reduction), float(label_smoothing))
-    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
-        return _xent_impl(logits, target, weight, ignore_index, reduction, label_smoothing)
+_xent_claimed = _jit_claimed(
+    _xent_impl, ("ignore_index", "reduction", "label_smoothing"),
+    lambda logits, target, weight=None, ignore_index=-100, reduction="mean",
+    label_smoothing=0.0: (
+        (logits, target, weight, int(ignore_index), str(reduction),
+         float(label_smoothing)), {}))
 
 
 ex.register_implementation(
@@ -825,15 +853,10 @@ def _rms_impl(a, normalized_shape, weight=None, eps=1e-6):
     return out.reshape(shape)
 
 
-_rms_jitted = jax.jit(_rms_impl, static_argnames=("normalized_shape", "eps"))
-
-
-def _rms_claimed(a, normalized_shape, weight=None, eps=1e-6):
-    shape_t = tuple(int(d) for d in normalized_shape)
-    try:
-        return _rms_jitted(a, shape_t, weight, float(eps))
-    except (TypeError, jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
-        return _rms_impl(a, shape_t, weight, eps)
+_rms_claimed = _jit_claimed(
+    _rms_impl, ("normalized_shape", "eps"),
+    lambda a, normalized_shape, weight=None, eps=1e-6: (
+        (a, tuple(int(d) for d in normalized_shape), weight, float(eps)), {}))
 
 
 ex.register_implementation(

@@ -173,10 +173,10 @@ class CausalSelfAttention(nn.Module):
         n_elem = cfg.rope_n_elem
         from ..parallel.context_parallel import current_seq_parallel_ctx
 
-        if (ng == nh and n_elem == hs and hs % 2 == 0
-                and current_seq_parallel_ctx() is None):
-            # fused rope+attention symbol: the pallas executor applies rope
-            # in-kernel (and rotates the rope VJP in-kernel in backward);
+        if n_elem == hs and hs % 2 == 0 and current_seq_parallel_ctx() is None:
+            # fused rope+attention symbol (GQA included: the kernel indexes
+            # kv blocks by q_head // group): the pallas executor applies
+            # rope in-kernel and rotates the rope VJP in-kernel in backward;
             # ring-attention CP rewrites plain sdpa bsyms, so it keeps the
             # decomposed path
             y = ltorch.rope_sdpa(q, k, v, cos, sin, is_causal=True,

@@ -850,7 +850,8 @@ def rope_sdpa(q, k, v, cos, sin, is_causal=True, scale=None):
         # silently undone on the unclaimed path)
         return clang.maybe_convert_to_dtype(out, x.dtype)
 
-    return sdpa.meta(rope(q), rope(k), v, is_causal=is_causal, scale=scale)
+    return sdpa.meta(rope(q), rope(k), v, is_causal=is_causal, scale=scale,
+                     enable_gqa=q.shape[1] != k.shape[1])
 
 
 @torchsymbol(name="sdpa", id="torch.nn.functional.scaled_dot_product_attention")
