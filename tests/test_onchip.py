@@ -106,3 +106,28 @@ def test_fp8_linear_faster_than_bf16_on_chip():
     ref = np.asarray(jnp.matmul(x.astype(jnp.float32), w.astype(jnp.float32).T))
     rel = np.abs(got - ref).mean() / np.abs(ref).mean()
     assert rel < 0.08, rel
+
+
+def test_gqa_rope_flash_train_step_on_chip():
+    """GQA fused rope+flash on real hardware: a grouped-head llama config
+    trains with decreasing loss through TrainStep (the kernels index kv
+    blocks by q_head // group; dkv group-sums per-q-head partials)."""
+    import thunder_tpu as tt
+    from thunder_tpu import optim
+    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.training import TrainStep
+    from thunder_tpu.transforms.autocast import AutocastTransform
+
+    import jax.numpy as jnp
+
+    cfg = Config.from_name("llama-350m", n_layer=2, n_query_groups=4,
+                           block_size=2048)
+    step = TrainStep(tt.jit(GPTForCausalLM(cfg), transforms=[AutocastTransform()]),
+                     optim.AdamW(lr=1e-4))
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 2048)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 2048)), jnp.int32)
+    losses = [float(step(idx, tgt)) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+    srcs = [t.python() for t in step._vag._cs.last_traces]
+    assert any("rope_flash_fwd" in s for s in srcs)
