@@ -119,10 +119,13 @@ def check_vjp(op, ref, sample: SampleInput, *, atol=1e-4, rtol=1e-4, argnums=Non
     """Compare thunder_tpu grads of sum(op(...)) against jax.grad of the reference."""
     import jax
 
-    tensor_argnums = tuple(
-        i for i, a in enumerate(sample.args)
-        if hasattr(a, "dtype") and jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
-    )
+    def _has_inexact_leaf(a):
+        return any(
+            hasattr(l, "dtype") and jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+            for l in jax.tree_util.tree_leaves(a)
+        )
+
+    tensor_argnums = tuple(i for i, a in enumerate(sample.args) if _has_inexact_leaf(a))
     if argnums is not None:
         tensor_argnums = tuple(i for i in tensor_argnums if i in argnums)
 
@@ -139,4 +142,9 @@ def check_vjp(op, ref, sample: SampleInput, *, atol=1e-4, rtol=1e-4, argnums=Non
     garg = grads[0]
     for i, rg in zip(tensor_argnums, rgrads):
         assert garg[i] is not None, f"missing grad for arg {i}"
-        assert_close(garg[i], rg, atol, rtol)
+        g_leaves = jax.tree_util.tree_leaves(garg[i])
+        r_leaves = jax.tree_util.tree_leaves(rg)
+        assert len(g_leaves) == len(r_leaves) and g_leaves, f"missing grad leaves for arg {i}"
+        for g, r in zip(g_leaves, r_leaves):
+            assert g is not None, f"missing grad leaf for arg {i}"
+            assert_close(g, r, atol, rtol)

@@ -780,10 +780,10 @@ wave3_opinfos = [
        (1 - np.vectorize(__import__("math").erf)(np.asarray(a, np.float64))),
        _unit_interval_samples, atol=1e-3, supports_grad=False),
     # stacking / reshaping
-    _a("dstack", jnp.dstack, _stack_list_samples, supports_grad=False),
-    _a("hstack", jnp.hstack, _stack_list_samples, supports_grad=False),
-    _a("vstack", jnp.vstack, _stack_list_samples, supports_grad=False),
-    _a("column_stack", jnp.column_stack, _stack_list_samples, supports_grad=False),
+    _a("dstack", jnp.dstack, _stack_list_samples),
+    _a("hstack", jnp.hstack, _stack_list_samples),
+    _a("vstack", jnp.vstack, _stack_list_samples),
+    _a("column_stack", jnp.column_stack, _stack_list_samples),
     _a("atleast_2d", jnp.atleast_2d, lambda rng, dt: iter([SampleInput((make_tensor(rng, (5,), dt),))])),
     _a("moveaxis", jnp.moveaxis, _moveaxis_samples),
     _a("swapdims", jnp.swapaxes, _moveaxis_samples),
@@ -864,41 +864,41 @@ grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]
 
 
 def _err_matmul(rng):
-    yield (make_tensor(rng, (3, 4), dtypes.float32), make_tensor(rng, (5, 6), dtypes.float32)), {}, Exception, "matmul"
+    yield (make_tensor(rng, (3, 4), dtypes.float32), make_tensor(rng, (5, 6), dtypes.float32)), {}, RuntimeError, "matmul"
 
 
 def _err_reshape(rng):
-    yield (make_tensor(rng, (3, 4), dtypes.float32), (5, 5)), {}, Exception, "reshape|mismatch"
+    yield (make_tensor(rng, (3, 4), dtypes.float32), (5, 5)), {}, RuntimeError, "reshape|mismatch"
 
 
 def _err_cat(rng):
-    yield ([make_tensor(rng, (2, 3), dtypes.float32), make_tensor(rng, (2, 3, 4), dtypes.float32)], 0), {}, Exception, "rank|cat"
+    yield ([make_tensor(rng, (2, 3), dtypes.float32), make_tensor(rng, (2, 3, 4), dtypes.float32)], 0), {}, RuntimeError, "rank|cat"
 
 
 def _err_squeeze(rng):
     # squeezing a non-1 dim is a silent no-op per torch; wrong dim index raises
-    yield (make_tensor(rng, (2, 3), dtypes.float32), 5), {}, Exception, "dim|range|rank"
+    yield (make_tensor(rng, (2, 3), dtypes.float32), 5), {}, IndexError, "dim|range|rank"
 
 
 def _err_embedding_bag(rng):
-    yield (jnp.zeros((2, 3), jnp.int32), make_tensor(rng, (5, 4), dtypes.float32)), {"mode": "meam"}, Exception, "mode"
+    yield (jnp.zeros((2, 3), jnp.int32), make_tensor(rng, (5, 4), dtypes.float32)), {"mode": "meam"}, RuntimeError, "mode"
 
 
 def _err_linear(rng):
-    yield (make_tensor(rng, (2, 8), dtypes.float32), make_tensor(rng, (4, 9), dtypes.float32)), {}, Exception, "linear"
+    yield (make_tensor(rng, (2, 8), dtypes.float32), make_tensor(rng, (4, 9), dtypes.float32)), {}, RuntimeError, "linear"
 
 
 def _err_conv2d(rng):
     # channel mismatch: must be caught at trace time by _convolution_meta
-    yield (make_tensor(rng, (1, 3, 8, 8), dtypes.float32), make_tensor(rng, (4, 5, 3, 3), dtypes.float32)), {}, Exception, "channels"
+    yield (make_tensor(rng, (1, 3, 8, 8), dtypes.float32), make_tensor(rng, (4, 5, 3, 3), dtypes.float32)), {}, RuntimeError, "channels"
 
 
 def _err_einsum(rng):
-    yield ("ij,jk->ik", make_tensor(rng, (3, 4), dtypes.float32)), {}, Exception, "operand"
+    yield ("ij,jk->ik", make_tensor(rng, (3, 4), dtypes.float32)), {}, ValueError, "operand"
 
 
 def _err_cross_entropy(rng):
-    yield (make_tensor(rng, (2, 3, 4), dtypes.float32), jnp.zeros((2,), jnp.int32)), {}, Exception, "logits"
+    yield (make_tensor(rng, (2, 3, 4), dtypes.float32), jnp.zeros((2,), jnp.int32)), {}, RuntimeError, "logits"
 
 
 ERROR_OPINFOS = [
@@ -924,198 +924,198 @@ def _t(rng, *shape):
 
 
 def _err_add(rng):
-    yield (_t(rng, 3, 4), _t(rng, 2, 5)), {}, Exception, "broadcast|shape"
+    yield (_t(rng, 3, 4), _t(rng, 2, 5)), {}, RuntimeError, "broadcast|shape"
 
 
 def _err_bmm(rng):
-    yield (_t(rng, 2, 3, 4), _t(rng, 3, 4, 5)), {}, Exception, "batch|matmul|shape"
+    yield (_t(rng, 2, 3, 4), _t(rng, 3, 4, 5)), {}, RuntimeError, "batch|matmul|shape"
 
 
 def _err_mv(rng):
-    yield (_t(rng, 3, 4), _t(rng, 5)), {}, Exception, "matmul|shape|contract"
+    yield (_t(rng, 3, 4), _t(rng, 5)), {}, RuntimeError, "matmul|shape|contract"
 
 
 def _err_linear_bias(rng):
-    yield (_t(rng, 2, 8), _t(rng, 4, 8), _t(rng, 5)), {}, Exception, "bias|shape"
+    yield (_t(rng, 2, 8), _t(rng, 4, 8), _t(rng, 5)), {}, RuntimeError, "bias|shape"
 
 
 def _err_embedding(rng):
-    yield (_t(rng, 2, 3), _t(rng, 5, 4)), {}, Exception, "int|index|dtype"
+    yield (_t(rng, 2, 3), _t(rng, 5, 4)), {}, ValueError, "int|index|dtype"
 
 
 def _err_gather(rng):
-    yield (_t(rng, 3, 4), 5, jnp.zeros((3, 4), jnp.int32)), {}, Exception, "dim|range"
+    yield (_t(rng, 3, 4), 5, jnp.zeros((3, 4), jnp.int32)), {}, IndexError, "dim|range"
 
 
 def _err_index_select(rng):
-    yield (_t(rng, 3, 4), 0, jnp.zeros((2, 2), jnp.int32)), {}, Exception, "1-?d|index|vector"
-    yield (_t(rng, 3, 4), 7, jnp.zeros((2,), jnp.int32)), {}, Exception, "dim|range"
+    yield (_t(rng, 3, 4), 0, jnp.zeros((2, 2), jnp.int32)), {}, RuntimeError, "1-?d|index|vector"
+    yield (_t(rng, 3, 4), 7, jnp.zeros((2,), jnp.int32)), {}, IndexError, "dim|range"
 
 
 def _err_cat_dim(rng):
-    yield ([_t(rng, 2, 3), _t(rng, 2, 3)], 5), {}, Exception, "dim|range"
-    yield ([], 0), {}, Exception, "empty|at least"
+    yield ([_t(rng, 2, 3), _t(rng, 2, 3)], 5), {}, IndexError, "dim|range"
+    yield ([], 0), {}, RuntimeError, "empty|at least"
 
 
 def _err_stack(rng):
-    yield ([_t(rng, 2, 3), _t(rng, 2, 4)],), {}, Exception, "shape|same"
+    yield ([_t(rng, 2, 3), _t(rng, 2, 4)],), {}, RuntimeError, "shape|same"
 
 
 def _err_split(rng):
-    yield (_t(rng, 6, 2), [2, 5]), {}, Exception, "size|sum|split"
+    yield (_t(rng, 6, 2), [2, 5]), {}, RuntimeError, "size|sum|split"
 
 
 def _err_transpose(rng):
-    yield (_t(rng, 3, 4), 0, 5), {}, Exception, "dim|range"
+    yield (_t(rng, 3, 4), 0, 5), {}, IndexError, "dim|range"
 
 
 def _err_permute(rng):
-    yield (_t(rng, 2, 3, 4), (0, 1)), {}, Exception, "permut|rank|length"
-    yield (_t(rng, 2, 3, 4), (0, 1, 1)), {}, Exception, "permut|dup|repeat"
+    yield (_t(rng, 2, 3, 4), (0, 1)), {}, RuntimeError, "permut|rank|length"
+    yield (_t(rng, 2, 3, 4), (0, 1, 1)), {}, RuntimeError, "permut|dup|repeat"
 
 
 def _err_expand(rng):
-    yield (_t(rng, 2, 3), (4, 3)), {}, Exception, "expand|broadcast|size"
+    yield (_t(rng, 2, 3), (4, 3)), {}, RuntimeError, "expand|broadcast|size"
 
 
 def _err_reshape_ambiguous(rng):
-    yield (_t(rng, 4, 6), (-1, -1)), {}, Exception, "-1|infer"
+    yield (_t(rng, 4, 6), (-1, -1)), {}, RuntimeError, "-1|infer"
 
 
 def _err_unsqueeze(rng):
-    yield (_t(rng, 2, 3), 6), {}, Exception, "dim|range"
+    yield (_t(rng, 2, 3), 6), {}, IndexError, "dim|range"
 
 
 def _err_flatten(rng):
-    yield (_t(rng, 2, 3, 4),), {"start_dim": 2, "end_dim": 1}, Exception, "start|end|dim"
+    yield (_t(rng, 2, 3, 4),), {"start_dim": 2, "end_dim": 1}, RuntimeError, "start|end|dim"
 
 
 def _err_softmax(rng):
-    yield (_t(rng, 2, 3), 5), {}, Exception, "dim|range"
+    yield (_t(rng, 2, 3), 5), {}, IndexError, "dim|range"
 
 
 def _err_layer_norm(rng):
-    yield (_t(rng, 2, 8), (7,)), {}, Exception, "normalized|shape"
+    yield (_t(rng, 2, 8), (7,)), {}, RuntimeError, "normalized|shape"
 
 
 def _err_group_norm(rng):
-    yield (_t(rng, 2, 6, 4), 4), {}, Exception, "group|divis|channel"
+    yield (_t(rng, 2, 6, 4), 4), {}, RuntimeError, "group|divis|channel"
 
 
 def _err_nll_loss(rng):
-    yield (_t(rng, 4, 5), jnp.zeros((3,), jnp.int32)), {}, Exception, "batch|shape|size"
+    yield (_t(rng, 4, 5), jnp.zeros((3,), jnp.int32)), {}, RuntimeError, "batch|shape|size"
 
 
 def _err_topk(rng):
-    yield (_t(rng, 5), 9), {}, Exception, "k|size|range"
+    yield (_t(rng, 5), 9), {}, ValueError, "k|size|range"
 
 
 def _err_scatter(rng):
-    yield (_t(rng, 3, 4), 9, jnp.zeros((3, 4), jnp.int32), _t(rng, 3, 4)), {}, Exception, "dim|range"
+    yield (_t(rng, 3, 4), 9, jnp.zeros((3, 4), jnp.int32), _t(rng, 3, 4)), {}, IndexError, "dim|range"
 
 
 def _err_pad(rng):
-    yield (_t(rng, 2, 3), (1, 2, 3)), {}, Exception, "pad|even|pairs"
+    yield (_t(rng, 2, 3), (1, 2, 3)), {}, RuntimeError, "pad|even|pairs"
 
 
 def _err_where(rng):
-    yield (jnp.zeros((2, 3), bool), _t(rng, 4, 5), _t(rng, 2, 3)), {}, Exception, "broadcast|shape"
+    yield (jnp.zeros((2, 3), bool), _t(rng, 4, 5), _t(rng, 2, 3)), {}, RuntimeError, "broadcast|shape"
 
 
 def _err_masked_fill(rng):
-    yield (_t(rng, 2, 3), _t(rng, 2, 3), 0.0), {}, Exception, "bool|mask"
+    yield (_t(rng, 2, 3), _t(rng, 2, 3), 0.0), {}, RuntimeError, "bool|mask"
 
 
 def _err_take_along(rng):
-    yield (_t(rng, 3, 4), jnp.zeros((3,), jnp.int32), 1), {}, Exception, "ndim|rank|dim"
+    yield (_t(rng, 3, 4), jnp.zeros((3,), jnp.int32), 1), {}, RuntimeError, "ndim|rank|dim"
 
 
 def _err_cumsum(rng):
-    yield (_t(rng, 2, 3), 4), {}, Exception, "dim|range"
+    yield (_t(rng, 2, 3), 4), {}, IndexError, "dim|range"
 
 
 def _err_argmax(rng):
-    yield (_t(rng, 2, 3), 5), {}, Exception, "dim|range"
+    yield (_t(rng, 2, 3), 5), {}, IndexError, "dim|range"
 
 
 def _err_chunk(rng):
-    yield (_t(rng, 6), 0), {}, Exception, "chunk|positive"
+    yield (_t(rng, 6), 0), {}, RuntimeError, "chunk|positive"
 
 
 def _err_unflatten(rng):
-    yield (_t(rng, 2, 12), 1, (5, 3)), {}, Exception, "unflatten|product|size"
+    yield (_t(rng, 2, 12), 1, (5, 3)), {}, RuntimeError, "unflatten|product|size"
 
 
 def _err_tensordot(rng):
-    yield (_t(rng, 3, 4), _t(rng, 5, 6)), {"dims": 1}, Exception, "contract|shape|dim"
+    yield (_t(rng, 3, 4), _t(rng, 5, 6)), {"dims": 1}, RuntimeError, "contract|shape|dim"
 
 
 def _err_conv_groups(rng):
-    yield (_t(rng, 1, 4, 8, 8), _t(rng, 4, 4, 3, 3)), {"groups": 3}, Exception, "group|divis|channel"
+    yield (_t(rng, 1, 4, 8, 8), _t(rng, 4, 4, 3, 3)), {"groups": 3}, RuntimeError, "group|divis|channel"
 
 
 def _err_avg_pool(rng):
-    yield (_t(rng, 1, 2, 8, 8), 0), {}, Exception, "kernel|positive"
+    yield (_t(rng, 1, 2, 8, 8), 0), {}, RuntimeError, "kernel|positive"
 
 
 def _err_sdpa(rng):
-    yield (_t(rng, 2, 4, 8, 16), _t(rng, 2, 4, 8, 32), _t(rng, 2, 4, 8, 32)), {}, Exception, "head|dim|shape"
+    yield (_t(rng, 2, 4, 8, 16), _t(rng, 2, 4, 8, 32), _t(rng, 2, 4, 8, 32)), {}, RuntimeError, "head|dim|shape"
 
 
 def _err_interpolate(rng):
-    yield (_t(rng, 1, 2, 8, 8),), {"size": (4, 4), "mode": "cubic-ish"}, Exception, "mode"
+    yield (_t(rng, 1, 2, 8, 8),), {"size": (4, 4), "mode": "cubic-ish"}, RuntimeError, "mode"
 
 
 def _err_norm_ord(rng):
-    yield (_t(rng, 3, 4),), {"p": "bad"}, Exception, "ord|p |norm"
+    yield (_t(rng, 3, 4),), {"p": "bad"}, RuntimeError, "ord|p |norm"
 
 
 def _err_tril_1d(rng):
-    yield (_t(rng, 5),), {}, Exception, "2|dim|matrix"
+    yield (_t(rng, 5),), {}, RuntimeError, "2|dim|matrix"
 
 
 def _err_repeat_interleave(rng):
-    yield (_t(rng, 3), -2), {}, Exception, "negative|positive|repeat"
+    yield (_t(rng, 3), -2), {}, RuntimeError, "negative|positive|repeat"
 
 
 def _err_one_hot(rng):
-    yield (jnp.zeros((3,), jnp.int32), -5), {}, Exception, "class|negative"
+    yield (jnp.zeros((3,), jnp.int32), -5), {}, RuntimeError, "class|positive|negative"
 
 
 def _err_clamp(rng):
-    yield (_t(rng, 3),), {}, Exception, "min|max|none"
+    yield (_t(rng, 3),), {}, RuntimeError, "min|max|none"
 
 
 def _err_broadcast_to(rng):
-    yield (_t(rng, 3, 4), (3, 5)), {}, Exception, "broadcast|shape"
+    yield (_t(rng, 3, 4), (3, 5)), {}, RuntimeError, "broadcast|shape"
 
 
 def _err_batch_norm(rng):
-    yield (_t(rng, 2, 3, 4), _t(rng, 5), _t(rng, 5)), {"training": False}, Exception, "running|channel|shape"
+    yield (_t(rng, 2, 3, 4), _t(rng, 5), _t(rng, 5)), {"training": False}, RuntimeError, "running|channel|shape"
 
 
 def _err_mse(rng):
-    yield (_t(rng, 2, 3), _t(rng, 4, 5)), {}, Exception, "broadcast|shape"
+    yield (_t(rng, 2, 3), _t(rng, 4, 5)), {}, RuntimeError, "broadcast|shape"
 
 
 def _err_dot(rng):
-    yield (_t(rng, 3), _t(rng, 4)), {}, Exception, "1D|size|shape"
+    yield (_t(rng, 3), _t(rng, 4)), {}, RuntimeError, "1D|size|shape"
 
 
 def _err_outer(rng):
-    yield (_t(rng, 2, 2), _t(rng, 3)), {}, Exception, "1D|vector|dim"
+    yield (_t(rng, 2, 2), _t(rng, 3)), {}, RuntimeError, "1D|vector|dim"
 
 
 def _err_diag_embed(rng):
-    yield (_t(rng, 3, 4),), {"dim1": 1, "dim2": 1}, Exception, "dim|distinct|same"
+    yield (_t(rng, 3, 4),), {"dim1": 1, "dim2": 1}, RuntimeError, "dim|distinct|same"
 
 
 def _err_roll(rng):
-    yield (_t(rng, 3, 4), (1, 2), (0,)), {}, Exception, "shift|dim|length"
+    yield (_t(rng, 3, 4), (1, 2), (0,)), {}, RuntimeError, "shift|dim|length"
 
 
 def _err_fold(rng):
-    yield (_t(rng, 1, 8, 4), (4, 4), (3, 3)), {}, Exception, "fold|block|size"
+    yield (_t(rng, 1, 8, 4), (4, 4), (3, 3)), {}, RuntimeError, "fold|block|size"
 
 
 ERROR_OPINFOS += [

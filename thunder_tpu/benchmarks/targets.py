@@ -57,6 +57,8 @@ def _jit(fn, **kw):
 
 def register(name):
     def deco(fn):
+        if name in BENCHMARKS:
+            raise ValueError(f"benchmark target '{name}' is already registered")
         BENCHMARKS[name] = fn
         return fn
 
@@ -125,19 +127,6 @@ def bench_gpt2_fwd(rng):
     tm = _jit(model)
     idx = jnp.asarray(rng.randint(0, 50000, (4, 1024)), jnp.int32)
     return _timeit(tm, idx, iters=5)
-
-
-@register("llama2_7b_attention")
-def bench_llama_attn(rng):
-    from thunder_tpu.models.litgpt import Config, CausalSelfAttention, build_rope_cache
-
-    cfg = Config.from_name("Llama-2-7b-hf")
-    attn = CausalSelfAttention(cfg, dtype=jnp.bfloat16)
-    tm = _jit(attn)
-    T = 2048
-    x = _tensor(rng, (1, T, cfg.n_embd))
-    cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
-    return _timeit(tm, x, cos, sin, iters=10)
 
 
 @register("litgpt_qkv_rope")

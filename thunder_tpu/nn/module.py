@@ -45,6 +45,26 @@ class Parameter:
         return f"Parameter(shape={tuple(self.shape)}, dtype={self.dtype}, requires_grad={self.requires_grad})"
 
 
+def repad_to_param(p: "Parameter", v, *, name: str = "?"):
+    """Coerce a checkpoint value onto a parameter's storage shape.
+
+    FSDP-padded params (``_padded_dim0``) save unpadded; loading re-applies
+    the dim-0 zero-pad so the padded-shard invariant holds for the next
+    compiled step. Any remaining shape mismatch raises — silently assigning a
+    wrong-shaped array would corrupt the module for every later step."""
+    v = jnp.asarray(v)
+    orig = getattr(p, "_padded_dim0", None)
+    if orig is not None and v.ndim >= 1 and v.shape[0] == orig:
+        pad = [(0, p.data.shape[0] - orig)] + [(0, 0)] * (v.ndim - 1)
+        v = jnp.pad(v, pad)
+    if tuple(v.shape) != tuple(p.data.shape):
+        raise ValueError(
+            f"state_dict shape mismatch for '{name}': checkpoint "
+            f"{tuple(v.shape)} vs parameter {tuple(p.data.shape)}"
+        )
+    return v
+
+
 class Module:
     """Stateful module tree (torch-flavored API, jax-array parameters)."""
 
@@ -139,14 +159,16 @@ class Module:
         for k, v in sd.items():
             if k in own_params:
                 p = own_params[k]
-                v = jnp.asarray(v)
-                orig = getattr(p, "_padded_dim0", None)
-                if orig is not None and v.shape[0] == orig:
-                    pad = [(0, p.data.shape[0] - orig)] + [(0, 0)] * (v.ndim - 1)
-                    v = jnp.pad(v, pad)
-                p.data = v
+                p.data = repad_to_param(p, v, name=k)
             elif k in own_buffers:
-                self._set_buffer_by_path(k, jnp.asarray(v))
+                v = jnp.asarray(v)
+                want = getattr(own_buffers[k], "shape", None)
+                if want is not None and tuple(v.shape) != tuple(want):
+                    raise ValueError(
+                        f"state_dict shape mismatch for buffer '{k}': checkpoint "
+                        f"{tuple(v.shape)} vs buffer {tuple(want)}"
+                    )
+                self._set_buffer_by_path(k, v)
             elif strict:
                 raise KeyError(f"unexpected key {k} in state_dict")
         if strict:

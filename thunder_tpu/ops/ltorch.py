@@ -407,6 +407,13 @@ def linspace(start, end, steps, *, device=None, dtype=None):
 
 @torchsymbol(name="one_hot")
 def one_hot(a, num_classes):
+    n = pyval(num_classes)
+    if n == -1:
+        raise RuntimeError(
+            "one_hot: num_classes=-1 (infer from data) needs a data-dependent "
+            "output shape XLA cannot express; pass the class count explicitly")
+    if n < 1:
+        raise RuntimeError(f"one_hot: num_classes must be positive, got {n}")
     c = prims.iota(num_classes, dtype=dtypes.int64 if a.dtype.is_int else a.dtype, device=a.device)
     expanded = clang.unsqueeze(a, -1)
     return clang.maybe_convert_to_dtype(clang.eq(expanded, clang.expand_to(c, expanded.shape[:-1] + (num_classes,))), dtypes.int64)
@@ -1573,7 +1580,15 @@ def diagonal_op(a, offset=0, dim1=0, dim2=1):
 
 
 @torchsymbol(name="diag_embed", method_names=("diag_embed",))
-def diag_embed(a, offset=0):
+def diag_embed(a, offset=0, dim1=-2, dim2=-1):
+    d1, d2 = pyval(dim1), pyval(dim2)
+    out_ndim = a.ndim + 1
+    for d in (d1, d2):
+        if not -out_ndim <= d < out_ndim:
+            raise IndexError(f"diag_embed: dim {d} out of range for rank {out_ndim}")
+    nd1, nd2 = d1 % out_ndim, d2 % out_ndim
+    if nd1 == nd2:
+        raise RuntimeError(f"diag_embed: dim1 ({d1}) and dim2 ({d2}) must be distinct")
     k = pyval(offset)
     m = a.shape[-1]
     n = m + builtins.abs(k)
@@ -1585,7 +1600,17 @@ def diag_embed(a, offset=0):
     gathered = clang.take(a, idx_flat, a.ndim - 1)
     gathered = clang.reshape(gathered, a.shape[:-1] + (n, n))
     mask_b = clang.expand_to(mask, gathered.shape)
-    return clang.where(mask_b, gathered, clang.full_like(gathered, 0))
+    out = clang.where(mask_b, gathered, clang.full_like(gathered, 0))
+    if (nd1, nd2) != (out_ndim - 2, out_ndim - 1):
+        # torch places the matrix dims at (dim1, dim2); moveaxis the trailing
+        # construction dims there
+        rest = iter(i for i in range(out_ndim) if i not in (out_ndim - 2, out_ndim - 1))
+        perm = [None] * out_ndim
+        perm[nd1] = out_ndim - 2
+        perm[nd2] = out_ndim - 1
+        perm = [next(rest) if p is None else p for p in perm]
+        out = clang.permute(out, tuple(perm))
+    return out
 
 
 @torchsymbol(name="meshgrid")

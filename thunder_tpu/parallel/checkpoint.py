@@ -41,6 +41,26 @@ def _orbax():
 def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None) -> None:
     """Save a (possibly sharded) param/optimizer state dict."""
     options = options or StateDictOptions()
+    if options.rank0_only:
+        # rank0_only with still-sharded device arrays would have rank 0 try to
+        # serialize data it does not own while other hosts have already
+        # returned — a deadlock on a real multi-host mesh. Treat rank0_only as
+        # implying host materialization (the torch reference requires
+        # full_state_dict with rank0_only for the same reason), refusing
+        # loudly when the data is not addressable from this host. Validate on
+        # EVERY rank (before the rank0 early-return) so all hosts fail
+        # consistently instead of rank 0 crashing while the rest keep going.
+        for leaf in jax.tree_util.tree_leaves(state_dict):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                raise ValueError(
+                    "save(rank0_only=True) cannot serialize arrays sharded "
+                    "across hosts; gather to a full host state dict first "
+                    "(get_model_state_dict(full_state_dict=True))"
+                )
+        if not (options.full_state_dict or options.cpu_offload):
+            options = StateDictOptions(
+                full_state_dict=options.full_state_dict, cpu_offload=True,
+                rank0_only=True)
     if options.rank0_only and jax.process_index() != 0:
         return
     if options.full_state_dict or options.cpu_offload:
@@ -102,32 +122,51 @@ def get_model_state_dict(tmodule, options: StateDictOptions | None = None) -> di
 
 
 def load_model_state_dict(sd: dict, tmodule, options: StateDictOptions | None = None) -> None:
-    """Restore params; resharding onto each param's current placement."""
-    import jax.numpy as jnp
+    """Restore params, resharding onto each param's current placement.
+
+    FSDP-padded params (``_padded_dim0``) save unpadded through
+    get_model_state_dict(full_state_dict=True); loading re-applies the dim-0
+    pad before device_put so the padded-shard invariant holds for the next
+    compiled step (mirrors Module.load_state_dict). Shape mismatches and
+    device_put failures raise — a silently unsharded/unpadded param would
+    corrupt the module for every later step."""
+    from ..nn.module import repad_to_param
 
     params = tmodule.get_parameters()
     for k, v in sd.items():
         p = params.get(k)
         if p is None:
             continue
-        arr = jnp.asarray(v)
+        arr = repad_to_param(p, v, name=k)
         sharding = getattr(p.data, "sharding", None)
         if sharding is not None:
-            try:
-                arr = jax.device_put(arr, sharding)
-            except Exception:
-                pass
+            arr = jax.device_put(arr, sharding)
         p.data = arr
 
 
 class _AsyncHandle:
-    """Handle returned by async_save: wait() blocks until the write is durable."""
+    """Handle returned by async_save: wait() blocks until the write is durable.
+
+    Callers MUST call wait() before process exit (or before relying on the
+    checkpoint existing) — dropping the handle gives no completion barrier."""
 
     def __init__(self, waiter):
         self._waiter = waiter
 
     def wait(self) -> None:
         self._waiter()
+
+
+# one AsyncCheckpointer per process: each instance owns a background thread
+# pool, so per-call construction would leak threads across a training run
+_async_ckptr = None
+
+
+def _get_async_checkpointer(ocp):
+    global _async_ckptr
+    if _async_ckptr is None:
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _async_ckptr
 
 
 def async_save(state_dict: dict, path: str, *,
@@ -143,7 +182,7 @@ def async_save(state_dict: dict, path: str, *,
     snap = jax.tree_util.tree_map(lambda x: np.asarray(x), state_dict)
     ocp = _orbax()
     if ocp is not None and hasattr(ocp, "AsyncCheckpointer"):
-        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        ckptr = _get_async_checkpointer(ocp)
         ckptr.save(os.path.abspath(path), snap, force=True)
         return _AsyncHandle(ckptr.wait_until_finished)
     import threading

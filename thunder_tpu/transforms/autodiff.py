@@ -1169,6 +1169,73 @@ def forward_and_backward_traces(trace: TraceCtx, *, grad_all_inexact_args: bool 
     return ForwardBackwardTraces(fwd, bwd, len(saved), grad_arg_names)
 
 
+class _TLeaf:
+    """Marker for an extracted tensor leaf inside a fallback op's argument
+    structure (index into the flat leaves list)."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i: int):
+        self.i = i
+
+
+def _extract_tensor_leaves(x, leaves: list):
+    """Replace every array-like leaf in a nested structure with a _TLeaf,
+    appending the array to ``leaves``. Traversal order mirrors
+    codeutils.flat_proxies (tuple/list elements in order, dict values in
+    order, slice start/stop/step) so runtime grads align with trace-time
+    flattened tensor proxies."""
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # namedtuple
+        return type(x)(*(_extract_tensor_leaves(e, leaves) for e in x))
+    if isinstance(x, (tuple, list)):
+        return type(x)(_extract_tensor_leaves(e, leaves) for e in x)
+    if isinstance(x, dict):
+        return {k: _extract_tensor_leaves(v, leaves) for k, v in x.items()}
+    if isinstance(x, slice):
+        return slice(
+            _extract_tensor_leaves(x.start, leaves),
+            _extract_tensor_leaves(x.stop, leaves),
+            _extract_tensor_leaves(x.step, leaves),
+        )
+    if hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, (bool, int, float, complex)):
+        leaves.append(x)
+        return _TLeaf(len(leaves) - 1)
+    return x
+
+
+def _fill_tensor_leaves(x, tensors):
+    if isinstance(x, _TLeaf):
+        return tensors[x.i]
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # namedtuple
+        return type(x)(*(_fill_tensor_leaves(e, tensors) for e in x))
+    if isinstance(x, (tuple, list)):
+        return type(x)(_fill_tensor_leaves(e, tensors) for e in x)
+    if isinstance(x, dict):
+        return {k: _fill_tensor_leaves(v, tensors) for k, v in x.items()}
+    if isinstance(x, slice):
+        return slice(
+            _fill_tensor_leaves(x.start, tensors),
+            _fill_tensor_leaves(x.stop, tensors),
+            _fill_tensor_leaves(x.step, tensors),
+        )
+    return x
+
+
+def _check_fallback_grads(name: str, grads: tuple, meta_spec: tuple) -> None:
+    """Loud-failure guard: a vjp fallback must produce exactly one gradient per
+    traced tensor input. A silent mismatch means some tensor input would get a
+    None/zero cotangent and part of the model would quietly stop training
+    (reference treats auto-registered grads via thunder/core/vjp_utils.py —
+    there, too, a missing grad is an error, not a None)."""
+    if len(grads) != len(meta_spec):
+        raise RuntimeError(
+            f"vjp fallback for '{name}' produced {len(grads)} input gradients but "
+            f"{len(meta_spec)} tensor inputs were traced. This usually means a tensor "
+            f"argument is nested in a container the fallback extraction does not walk; "
+            f"fix _extract_tensor_leaves or register an explicit grad rule for '{name}'."
+        )
+
+
 _fallback_sym_cache: dict = {}
 
 
@@ -1191,15 +1258,19 @@ def _make_fallback_symbols(sym: Symbol, impl: Callable):
         return out, res
 
     def fwd_impl(*args, **kwargs):
-        tensor_idx = [i for i, a in enumerate(args) if hasattr(a, "shape") and hasattr(a, "dtype")]
+        # Extract tensor leaves from the FULL nested structure (lists/tuples/
+        # dicts/slices), in the same deterministic order codeutils.flat_proxies
+        # walks proxies at trace time — so grads returned by the vjp closure
+        # align 1:1 with the TapeEntry's flattened tensor inputs. Top-level-only
+        # extraction silently dropped grads for list-input ops (dstack et al.).
+        leaves: list = []
+        extracted = _extract_tensor_leaves((list(args), dict(kwargs)), leaves)
 
         def call(*tensors):
-            full = list(args)
-            for i, t in zip(tensor_idx, tensors):
-                full[i] = t
-            return impl(*full, **kwargs)
+            f_args, f_kwargs = _fill_tensor_leaves(extracted, tensors)
+            return impl(*f_args, **f_kwargs)
 
-        out, vjp_fn = jax.vjp(call, *[args[i] for i in tensor_idx])
+        out, vjp_fn = jax.vjp(call, *leaves)
         return out, vjp_fn
 
     fwd_sym = Symbol(f"{sym.name}_vjp_fwd", fwd_meta, id=f"vjp_fwd.{sym.name}", is_prim=True,
@@ -1210,8 +1281,9 @@ def _make_fallback_symbols(sym: Symbol, impl: Callable):
 
     def bwd_impl(res, meta_spec, *cots):
         vjp_fn = res
-        grads = vjp_fn(cots[0] if len(cots) == 1 else tuple(cots))
-        return tuple(grads)
+        grads = tuple(vjp_fn(cots[0] if len(cots) == 1 else tuple(cots)))
+        _check_fallback_grads(sym.name, grads, meta_spec)
+        return grads
 
     bwd_sym = Symbol(f"{sym.name}_vjp_bwd", bwd_meta, id=f"vjp_bwd.{sym.name}", is_prim=True,
                      module="autodiff", tags=(OpTags.DONT_FUSE,), python_impl=bwd_impl)
