@@ -853,8 +853,468 @@ wave3_opinfos = [
 wave3_opinfos = [oi for oi in wave3_opinfos if oi is not None]
 
 
+# ---------------------------------------------------------------------------
+# wave 4 (round 4): the remaining ltorch surface — trig/hyperbolic, bitwise/
+# logical, reduction variants, split family, factories, conv/pool 1d/3d,
+# losses, blas composites, indexing writes (reference opinfos.py:289 reaches
+# 247 instances; this wave closes our count toward it, with grads wherever
+# torch is differentiable)
+# ---------------------------------------------------------------------------
+
+BOOL = (dtypes.bool8,)
+
+
+def _bounded_unary(low, high):
+    def gen(rng, dtype):
+        for shape in ((7,), (3, 4)):
+            yield SampleInput((make_tensor(rng, shape, dtype, low=low, high=high),))
+    return gen
+
+
+def _bool_pair(rng, dtype):
+    yield SampleInput((make_tensor(rng, (3, 4), dtypes.bool8), make_tensor(rng, (3, 4), dtypes.bool8)))
+
+
+def _int_mat_pair(rng, dtype):
+    yield SampleInput((jnp.asarray(rng.randint(0, 16, (3, 4)), jnp.int32),
+                       jnp.asarray(rng.randint(0, 5, (3, 4)), jnp.int32)))
+
+
+def _first_of(op):
+    return lambda *a, **kw: op(*a, **kw)[0]
+
+
+wave4_opinfos = [
+    # --- trig / hyperbolic / misc unary ---
+    _u("acos", jnp.arccos, _bounded_unary(-0.9, 0.9), dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("acosh", jnp.arccosh, _bounded_unary(1.1, 3.0), dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("asin", jnp.arcsin, _bounded_unary(-0.9, 0.9), dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("asinh", jnp.arcsinh, dts=F32_64),
+    _u("atan", jnp.arctan, dts=F32_64),
+    _u("atanh", jnp.arctanh, _bounded_unary(-0.9, 0.9), dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("cosh", jnp.cosh, dts=F32_64),
+    _u("sinh", jnp.sinh, dts=F32_64),
+    _u("tan", jnp.tan, _bounded_unary(-1.0, 1.0), dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("erfc", jax.scipy.special.erfc, dts=F32_64, atol=1e-4, rtol=1e-4),
+    _u("erfinv", jax.scipy.special.erfinv, _bounded_unary(-0.9, 0.9), dts=F32, atol=1e-3, rtol=1e-3),
+    _u("exp2", jnp.exp2, dts=F32_64),
+    _u("log2", jnp.log2, positive_unary_samples, dts=F32_64),
+    _u("reciprocal", jnp.reciprocal, positive_unary_samples, dts=F32_64),
+    _u("leaky_relu", lambda x: jnp.where(x >= 0, x, 0.01 * x), dts=F32_64),
+    _u("relu6", lambda x: jnp.clip(x, 0.0, 6.0), dts=F32_64),
+    _u("mish", lambda x: x * jnp.tanh(jnp.log1p(jnp.exp(x))), dts=F32, atol=1e-3, rtol=1e-3),
+    _u("softplus", lambda x: jnp.log1p(jnp.exp(x)), dts=F32, atol=1e-3, rtol=1e-3),
+    _u("logit", lambda x: jnp.log(x / (1 - x)), _bounded_unary(0.05, 0.95), dts=F32, atol=1e-3, rtol=1e-3),
+    _u("positive", lambda x: x, dts=F32_64),
+    OpInfo(name="trunc", op=ltorch.trunc, ref=jnp.trunc, sample_generator=elementwise_unary_samples,
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="round", op=ltorch.round, ref=jnp.round, sample_generator=elementwise_unary_samples,
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="isinf", op=ltorch.isinf, ref=jnp.isinf,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray([1.0, jnp.inf, -jnp.inf, jnp.nan], jnp.float32),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="bitwise_not", op=ltorch.bitwise_not, ref=jnp.bitwise_not,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray(rng.randint(0, 100, (3, 4)), jnp.int32),))]),
+           dtypes=(dtypes.int32,), supports_grad=False),
+    # --- binary: bitwise / logical / comparisons / arithmetic variants ---
+    OpInfo(name="atan2", op=ltorch.atan2, ref=jnp.arctan2, sample_generator=_pair_samples,
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="bitwise_and", op=ltorch.bitwise_and, ref=jnp.bitwise_and,
+           sample_generator=_int_mat_pair, dtypes=(dtypes.int32,), supports_grad=False),
+    OpInfo(name="bitwise_or", op=ltorch.bitwise_or, ref=jnp.bitwise_or,
+           sample_generator=_int_mat_pair, dtypes=(dtypes.int32,), supports_grad=False),
+    OpInfo(name="bitwise_xor", op=ltorch.bitwise_xor, ref=jnp.bitwise_xor,
+           sample_generator=_int_mat_pair, dtypes=(dtypes.int32,), supports_grad=False),
+    OpInfo(name="bitwise_left_shift", op=ltorch.bitwise_left_shift, ref=jnp.left_shift,
+           sample_generator=_int_mat_pair, dtypes=(dtypes.int32,), supports_grad=False),
+    OpInfo(name="bitwise_right_shift", op=ltorch.bitwise_right_shift, ref=jnp.right_shift,
+           sample_generator=_int_mat_pair, dtypes=(dtypes.int32,), supports_grad=False),
+    OpInfo(name="floor_divide", op=ltorch.floor_divide, ref=jnp.floor_divide,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=1.0, high=3.0)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="fmod", op=ltorch.fmod, ref=jnp.fmod,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=1.0, high=3.0)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="remainder", op=ltorch.remainder, ref=jnp.remainder,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=1.0, high=3.0)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="true_divide", op=ltorch.true_divide, ref=jnp.true_divide,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt, low=0.5, high=2.0)))]),
+           dtypes=F32_64),
+    OpInfo(name="gt", op=ltorch.gt, ref=jnp.greater, sample_generator=elementwise_binary_samples,
+           dtypes=F32_64 + INTS, supports_grad=False),
+    OpInfo(name="le", op=ltorch.le, ref=jnp.less_equal, sample_generator=elementwise_binary_samples,
+           dtypes=F32_64 + INTS, supports_grad=False),
+    OpInfo(name="ne", op=ltorch.ne, ref=jnp.not_equal, sample_generator=elementwise_binary_samples,
+           dtypes=F32_64 + INTS, supports_grad=False),
+    OpInfo(name="logical_and", op=ltorch.logical_and, ref=jnp.logical_and,
+           sample_generator=_bool_pair, dtypes=BOOL, supports_grad=False),
+    OpInfo(name="logical_or", op=ltorch.logical_or, ref=jnp.logical_or,
+           sample_generator=_bool_pair, dtypes=BOOL, supports_grad=False),
+    OpInfo(name="logical_xor", op=ltorch.logical_xor, ref=jnp.logical_xor,
+           sample_generator=_bool_pair, dtypes=BOOL, supports_grad=False),
+    OpInfo(name="logical_not", op=ltorch.logical_not, ref=jnp.logical_not,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dtypes.bool8),))]),
+           dtypes=BOOL, supports_grad=False),
+    OpInfo(name="ldexp", op=ltorch.ldexp, ref=jnp.ldexp,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), jnp.asarray(rng.randint(-3, 4, (3, 4)), jnp.int32)))]),
+           dtypes=F32_64),
+    OpInfo(name="lerp_tensor", op=ltorch.lerp, ref=lambda a, b, w: a + w * (b - a),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt),
+                            make_tensor(rng, (3, 4), dt, low=0.0, high=1.0)))]),
+           dtypes=F32_64),
+    OpInfo(name="zeta", op=ltorch.zeta, ref=jax.scipy.special.zeta,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4,), dt, low=1.5, high=4.0),
+                            make_tensor(rng, (4,), dt, low=1.0, high=3.0)))]),
+           dtypes=F32_64, atol=1e-3, rtol=1e-3, supports_grad=False),
+    OpInfo(name="clamp_max", op=ltorch.clamp_max, ref=jnp.minimum, sample_generator=_pair_samples,
+           dtypes=F32_64),
+    OpInfo(name="addcdiv", op=lambda a, t1, t2: ltorch.addcdiv(a, t1, t2, value=0.5),
+           ref=lambda a, t1, t2: a + 0.5 * t1 / t2,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dt),
+                            make_tensor(rng, (3, 4), dt, low=0.5, high=2.0)))]),
+           dtypes=F32_64),
+    # --- reductions ---
+    OpInfo(name="all_op", op=ltorch.all, ref=lambda a, dim=None, keepdim=False: jnp.all(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dtypes.bool8),)),
+               SampleInput((make_tensor(rng, (3, 4), dtypes.bool8),), {"dim": 1}),
+           ]), dtypes=BOOL, supports_grad=False),
+    OpInfo(name="any_op", op=ltorch.any, ref=lambda a, dim=None, keepdim=False: jnp.any(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dtypes.bool8),)),
+               SampleInput((make_tensor(rng, (3, 4), dtypes.bool8),), {"dim": 0, "keepdim": True}),
+           ]), dtypes=BOOL, supports_grad=False),
+    OpInfo(name="argmin", op=ltorch.argmin, ref=lambda a, dim=None, keepdim=False: jnp.argmin(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),), {"dim": 1})]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="argsort", op=ltorch.argsort, ref=lambda a, dim=-1, descending=False: jnp.argsort(-a if descending else a, axis=dim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 5), dt),)),
+               SampleInput((make_tensor(rng, (3, 5), dt),), {"descending": True}),
+           ]), dtypes=F32, supports_grad=False),
+    OpInfo(name="sort_values", op=lambda a: ltorch.sort(a)[0], ref=lambda a: jnp.sort(a, axis=-1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="prod_op", op=ltorch.prod, ref=lambda a, dim=None, keepdim=False: jnp.prod(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt, low=0.5, high=1.5),)),
+               SampleInput((make_tensor(rng, (3, 4), dt, low=0.5, high=1.5),), {"dim": 1}),
+           ]), dtypes=F32_64),
+    OpInfo(name="std_op", op=ltorch.std, ref=lambda a, dim=None, keepdim=False: jnp.std(a, axis=dim, keepdims=keepdim, ddof=1),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 8), dt),)),
+               SampleInput((make_tensor(rng, (3, 8), dt),), {"dim": 1}),
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="std_mean_std", op=_first_of(ltorch.std_mean),
+           ref=lambda a, dim=None, keepdim=False: jnp.std(a, axis=dim, keepdims=keepdim, ddof=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 8), dt),), {"dim": 1})]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="var_mean_var", op=_first_of(ltorch.var_mean),
+           ref=lambda a, dim=None, keepdim=False: jnp.var(a, axis=dim, keepdims=keepdim, ddof=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 8), dt),), {"dim": 1})]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="nanmean", op=ltorch.nanmean,
+           ref=lambda a, dim=None, keepdim=False: jnp.nanmean(a, axis=dim, keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray([[1.0, jnp.nan], [2.0, 3.0]], jnp.float32),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="aminmax_min", op=lambda a: ltorch.aminmax(a)[0], ref=lambda a: jnp.min(a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 5), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="vector_norm", op=ltorch.vector_norm,
+           ref=lambda a, ord=2, dim=None, keepdim=False: jnp.linalg.norm(a.ravel() if dim is None else a, ord=ord,
+                                                                          axis=None if dim is None else dim,
+                                                                          keepdims=keepdim),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 8), dt),)),
+               SampleInput((make_tensor(rng, (3, 8), dt),), {"ord": 1, "dim": 1}),
+           ]), dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    # --- shape / view family ---
+    OpInfo(name="atleast_1d", op=ltorch.atleast_1d, ref=jnp.atleast_1d,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="atleast_3d", op=ltorch.atleast_3d, ref=jnp.atleast_3d,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="movedim", op=lambda a: ltorch.movedim(a, 0, 2), ref=lambda a: jnp.moveaxis(a, 0, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="matrix_transpose", op=ltorch.matrix_transpose, ref=lambda a: jnp.swapaxes(a, -2, -1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="expand_as", op=ltorch.expand_as, ref=lambda a, b: jnp.broadcast_to(a, b.shape),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (1, 4), dt), make_tensor(rng, (3, 4), dt)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="hsplit", op=lambda a: ltorch.hsplit(a, 2), ref=lambda a: jnp.split(a, 2, axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="vsplit", op=lambda a: ltorch.vsplit(a, 2), ref=lambda a: jnp.split(a, 2, axis=0),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="tensor_split", op=lambda a: ltorch.tensor_split(a, 3, 1),
+           ref=lambda a: jnp.array_split(a, 3, axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 7), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="split_with_sizes", op=lambda a: ltorch.split_with_sizes(a, (2, 3, 1), 1),
+           ref=lambda a: jnp.split(a, [2, 5], axis=1),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 6), dt),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="dstack", op=lambda a, b: ltorch.dstack([a, b]), ref=lambda a, b: jnp.dstack([a, b]),
+           sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="column_stack", op=lambda a, b: ltorch.column_stack([a, b]),
+           ref=lambda a, b: jnp.column_stack([a, b]), sample_generator=_pair_samples, dtypes=F32_64),
+    OpInfo(name="clone", op=ltorch.clone, ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="contiguous", op=ltorch.contiguous, ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="detach", op=ltorch.detach, ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="view_as", op=ltorch.view_as, ref=lambda a, b: jnp.reshape(a, b.shape),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (2, 6), dt)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="roll_1d", op=lambda a: ltorch.roll_1d(a, 2), ref=lambda a: jnp.roll(a, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (7,), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="pixel_unshuffle", op=lambda a: ltorch.pixel_unshuffle(a, 2),
+           ref=lambda a: _ref_pixel_unshuffle(a, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 2, 6, 6), dt),))]),
+           dtypes=F32_64),
+    # --- indexing writes ---
+    OpInfo(name="index_add", op=lambda a, idx, src: ltorch.index_add(a, 0, idx, src),
+           ref=lambda a, idx, src: a.at[idx].add(src),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5, 4), dt), jnp.asarray([0, 2, 4]),
+                            make_tensor(rng, (3, 4), dt)))]),
+           dtypes=F32_64),
+    OpInfo(name="index_copy", op=lambda a, idx, src: ltorch.index_copy(a, 0, idx, src),
+           ref=lambda a, idx, src: a.at[idx].set(src),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5, 4), dt), jnp.asarray([0, 2, 4]),
+                            make_tensor(rng, (3, 4), dt)))]),
+           dtypes=F32_64),
+    OpInfo(name="index_put", op=lambda a, idx, v: ltorch.index_put(a, (idx,), v),
+           ref=lambda a, idx, v: a.at[idx].set(v),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5, 4), dt), jnp.asarray([1, 3]),
+                            make_tensor(rng, (2, 4), dt)))]),
+           dtypes=F32_64),
+    OpInfo(name="index_put_accumulate", op=lambda a, idx, v: ltorch.index_put(a, (idx,), v, True),
+           ref=lambda a, idx, v: a.at[idx].add(v),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (5, 4), dt), jnp.asarray([1, 3]),
+                            make_tensor(rng, (2, 4), dt)))]),
+           dtypes=F32_64),
+    OpInfo(name="scatter_add", op=lambda a, idx, src: ltorch.scatter_add(a, 1, idx, src),
+           ref=lambda a, idx, src: _ref_scatter_add(a, idx, src),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 10), dt), jnp.asarray(rng.randint(0, 10, (4, 3))),
+                            make_tensor(rng, (4, 3), dt)))]),
+           dtypes=F32_64),
+    # --- factories (value-deterministic ones) ---
+    OpInfo(name="arange", op=lambda: ltorch.arange(0, 10, 2), ref=lambda: jnp.arange(0, 10, 2),
+           sample_generator=lambda rng, dt: iter([SampleInput(())]), dtypes=F32, supports_grad=False),
+    OpInfo(name="linspace", op=lambda: ltorch.linspace(0.0, 1.0, 7), ref=lambda: jnp.linspace(0.0, 1.0, 7),
+           sample_generator=lambda rng, dt: iter([SampleInput(())]), dtypes=F32, supports_grad=False),
+    OpInfo(name="logspace", op=lambda: ltorch.logspace(0.0, 2.0, 5), ref=lambda: jnp.logspace(0.0, 2.0, 5),
+           sample_generator=lambda rng, dt: iter([SampleInput(())]), dtypes=F32, supports_grad=False,
+           atol=1e-4, rtol=1e-4),
+    OpInfo(name="zeros_full_ones", op=lambda: ltorch.zeros(2, 3) + ltorch.ones(2, 3) + ltorch.full((2, 3), 2.0),
+           ref=lambda: jnp.full((2, 3), 3.0),
+           sample_generator=lambda rng, dt: iter([SampleInput(())]), dtypes=F32, supports_grad=False),
+    OpInfo(name="zeros_like", op=ltorch.zeros_like, ref=jnp.zeros_like,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="ones_like", op=ltorch.ones_like, ref=jnp.ones_like,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="full_like", op=lambda a: ltorch.full_like(a, 1.5), ref=lambda a: jnp.full_like(a, 1.5),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    # --- conv / pool 1d & 3d ---
+    OpInfo(name="conv1d", op=ltorch.conv1d,
+           ref=lambda x, w: jax.lax.conv_general_dilated(x, w, (1,), [(0, 0)],
+                                                         dimension_numbers=("NCH", "OIH", "NCH")),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 10), dt), make_tensor(rng, (4, 3, 3), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="conv3d", op=ltorch.conv3d,
+           ref=lambda x, w: jax.lax.conv_general_dilated(x, w, (1, 1, 1), [(0, 0)] * 3,
+                                                         dimension_numbers=("NCDHW", "OIDHW", "NCDHW")),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (1, 2, 5, 5, 5), dt), make_tensor(rng, (3, 2, 2, 2, 2), dt)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="conv_transpose1d", op=lambda x, w: ltorch.conv_transpose1d(x, w, stride=2),
+           ref=lambda x, w: jax.lax.conv_transpose(x, w, (2,), "VALID",
+                                                   dimension_numbers=("NCH", "OIH", "NCH"),
+                                                   transpose_kernel=True),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 6), dt), make_tensor(rng, (3, 4, 2), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="avg_pool1d", op=lambda a: ltorch.avg_pool1d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, 2), (1, 1, 2), "VALID") / 2.0,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 8), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="avg_pool3d", op=lambda a: ltorch.avg_pool3d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, 2, 2, 2), (1, 1, 2, 2, 2), "VALID") / 8.0,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (1, 2, 4, 4, 4), dt),))]),
+           dtypes=F32),
+    OpInfo(name="max_pool1d", op=lambda a: ltorch.max_pool1d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, 2), (1, 1, 2), "VALID"),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 3, 8), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="max_pool3d", op=lambda a: ltorch.max_pool3d(a, 2),
+           ref=lambda a: jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, 2, 2, 2), (1, 1, 2, 2, 2), "VALID"),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (1, 2, 4, 4, 4), dt),))]),
+           dtypes=F32),
+    OpInfo(name="adaptive_max_pool2d", op=lambda a: ltorch.adaptive_max_pool2d(a, (2, 2)),
+           ref=lambda a: jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, 4, 4), (1, 1, 4, 4), "VALID"),
+           sample_generator=_nchw_samples, dtypes=F32_64),
+    # --- nn functional / losses ---
+    OpInfo(name="softmin", op=ltorch.softmin, ref=lambda a, dim=-1: jax.nn.softmax(-a, axis=dim),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 9), dt),))]),
+           dtypes=F32_64, atol=1e-5, rtol=1e-5),
+    OpInfo(name="pairwise_distance", op=ltorch.pairwise_distance,
+           ref=lambda a, b: jnp.linalg.norm(a - b + 1e-6, axis=-1),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 8), dt), make_tensor(rng, (4, 8), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="local_response_norm", op=lambda a: ltorch.local_response_norm(a, 3),
+           ref=lambda a: _ref_lrn(a, 3),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 6, 5, 5), dt),))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="soft_margin_loss", op=ltorch.soft_margin_loss,
+           ref=lambda x, y: jnp.mean(jnp.log1p(jnp.exp(-y * x))),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt),
+                            jnp.sign(make_tensor(rng, (4, 5), dt))))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="hinge_embedding_loss", op=ltorch.hinge_embedding_loss,
+           ref=lambda x, y: jnp.mean(jnp.where(y == 1, x, jnp.maximum(0.0, 1.0 - x))),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt, low=0.1, high=2.0),
+                            jnp.sign(make_tensor(rng, (4, 5), dt))))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="margin_ranking_loss", op=ltorch.margin_ranking_loss,
+           ref=lambda x1, x2, y: jnp.mean(jnp.maximum(0.0, -y * (x1 - x2))),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (4, 5), dt),
+                            jnp.sign(make_tensor(rng, (4, 5), dt))))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4),
+    OpInfo(name="nll_loss_op", op=ltorch.nll_loss,
+           ref=lambda lp, t: -jnp.mean(jnp.take_along_axis(lp, t[:, None], axis=1)[:, 0]),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jax.nn.log_softmax(make_tensor(rng, (6, 5), dt), axis=-1),
+                            jnp.asarray(rng.randint(0, 5, (6,)))))]),
+           dtypes=F32_64, atol=1e-5, rtol=1e-5),
+    OpInfo(name="dropout_identity", op=lambda a: ltorch.dropout(a, 0.0, True),
+           ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="swiglu", op=ltorch.swiglu, ref=lambda g, u: jax.nn.silu(g) * u,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 8), dt), make_tensor(rng, (3, 8), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    # --- blas composites / linalg extras ---
+    OpInfo(name="vdot", op=ltorch.vdot, ref=jnp.vdot,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (6,), dt), make_tensor(rng, (6,), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="addbmm", op=ltorch.addbmm,
+           ref=lambda i, b1, b2: i + jnp.sum(b1 @ b2, axis=0),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 5), dt), make_tensor(rng, (2, 3, 4), dt),
+                            make_tensor(rng, (2, 4, 5), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="multi_dot", op=lambda a, b, c: ltorch.multi_dot([a, b, c]),
+           ref=lambda a, b, c: a @ b @ c,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (4, 5), dt),
+                            make_tensor(rng, (5, 2), dt)))]),
+           dtypes=F32_64, atol=1e-4, rtol=1e-4),
+    OpInfo(name="grouped_mm", op=ltorch.grouped_mm,
+           ref=lambda a, b, gs: jax.lax.ragged_dot(a, b, gs),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (8, 4), dt), make_tensor(rng, (3, 4, 5), dt),
+                            jnp.asarray([3, 2, 3], jnp.int32)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4, supports_grad=False),
+    # --- misc ---
+    OpInfo(name="polygamma1", op=lambda a: ltorch.polygamma(1, a),
+           ref=lambda a: jax.scipy.special.polygamma(1, a),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (5,), dt, low=0.5, high=3.0),))]),
+           dtypes=F32, atol=1e-3, rtol=1e-3, supports_grad=False),
+    OpInfo(name="frexp_mantissa", op=lambda a: ltorch.frexp(a)[0],
+           ref=lambda a: jnp.frexp(a)[0],
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (5,), dt, low=0.3, high=8.0),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="polar_real", op=lambda r, t: ltorch.real(ltorch.polar(r, t)),
+           ref=lambda r, t: r * jnp.cos(t),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4,), dt, low=0.5, high=2.0),
+                            make_tensor(rng, (4,), dt)))]),
+           dtypes=F32, atol=1e-4, rtol=1e-4, supports_grad=False),
+    OpInfo(name="masked_fill", op=lambda a, m: ltorch.masked_fill(a, m, 0.5),
+           ref=lambda a, m: jnp.where(m, 0.5, a),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (3, 4), dt), make_tensor(rng, (3, 4), dtypes.bool8)))]),
+           dtypes=F32_64),
+    OpInfo(name="clamp", op=lambda a: ltorch.clamp(a, -0.5, 0.5), ref=lambda a: jnp.clip(a, -0.5, 0.5),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="one_hot", op=lambda i: ltorch.one_hot(i, 6), ref=lambda i: jax.nn.one_hot(i, 6, dtype=jnp.int64),
+           sample_generator=lambda rng, dt: iter([SampleInput((jnp.asarray(rng.randint(0, 6, (7,))),))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="take_along_dim", op=lambda a, idx: ltorch.take_along_dim(a, idx, 1),
+           ref=lambda a, idx: jnp.take_along_axis(a, idx, axis=1),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 10), dt), jnp.asarray(rng.randint(0, 10, (4, 3)))))]),
+           dtypes=F32_64),
+    OpInfo(name="chunk", op=lambda a: ltorch.cat(list(ltorch.chunk(a, 3, 1)), 1), ref=lambda a: a,
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (2, 9), dt),))]),
+           dtypes=F32_64),
+]
+
+
+def _ref_pixel_unshuffle(a, r):
+    N, C, H, W = a.shape
+    out = a.reshape(N, C, H // r, r, W // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4)
+    return out.reshape(N, C * r * r, H // r, W // r)
+
+
+def _ref_scatter_add(a, idx, src):
+    out = a
+    for i in range(idx.shape[0]):
+        out = out.at[i, idx[i]].add(src[i])
+    return out
+
+
+def _ref_lrn(a, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = a * a
+    pad = (size - 1) // 2
+    padded = jnp.pad(sq, ((0, 0), (pad, size - 1 - pad), (0, 0), (0, 0)))
+    div = sum(padded[:, i:i + a.shape[1]] for i in range(size))
+    return a / (k + alpha / size * div) ** beta
+
+
 all_opinfos = (unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos
-               + nn_opinfos + widened_opinfos + wave2_opinfos + wave3_opinfos)
+               + nn_opinfos + widened_opinfos + wave2_opinfos + wave3_opinfos
+               + wave4_opinfos)
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]
 
 

@@ -235,6 +235,41 @@ SAMPLES = {
     "unravel_index": lambda r: ((np.array([3, 7]), (3, 4)), {}),
     "mode": lambda r: ((np.array([[1.0, 2.0, 2.0, 3.0], [0.0, 0.0, 1.0, 2.0]], np.float32),), {}),
     "is_same_size": None,  # returns a python bool; checked in dedicated test
+    # --- wave 8 ---
+    "convolution": lambda r: ((_f(r, 2, 3, 8, 8), _f(r, 4, 3, 3, 3), _f(r, 4),
+                               (1, 1), (1, 1), (1, 1), False, (0, 0), 1), {}),
+    "scaled_dot_product_attention": lambda r: ((_f(r, 2, 3, 6, 8), _f(r, 2, 3, 6, 8),
+                                                _f(r, 2, 3, 6, 8)), {"is_causal": True}),
+    "native_batch_norm": lambda r: ((_f(r, 4, 3, 5), _pos(r, 3), _f(r, 3),
+                                     np.zeros(3, np.float32), np.ones(3, np.float32),
+                                     True, 0.1, 1e-5), {}),
+    "linalg_matmul": lambda r: ((_f(r, 3, 4), _f(r, 4, 5)), {}),
+    "linalg_diagonal": lambda r: ((_f(r, 4, 5),), {}),
+    "linalg_vander": lambda r: ((_f(r, 4),), {}),
+    "special_logit": lambda r: ((np.clip(np.abs(_f(r, 3, 4)), 0.05, 0.95),), {}),
+    "gradient": lambda r: ((_f(r, 6),), {}),
+    "fill": lambda r: ((_f(r, 3, 4), 1.5), {}),
+    "alias_copy": lambda r: ((_f(r, 3, 4),), {}),
+    "upsample_nearest": lambda r: ((_f(r, 1, 2, 4, 4),), {"scale_factor": 2.0}),
+    "upsample_bilinear": lambda r: ((_f(r, 1, 2, 4, 4),), {"scale_factor": 2}),
+    "upsample": lambda r: ((_f(r, 1, 2, 4, 4),), {"scale_factor": 2.0, "mode": "nearest"}),
+    "rrelu": lambda r: ((_f(r, 3, 4),), {"training": False}),
+    "adaptive_max_pool3d": lambda r: ((_f(r, 1, 2, 6, 6, 6), (3, 3, 3)), {}),
+    "adaptive_max_pool3d_with_indices": lambda r: ((_f(r, 1, 2, 6, 6, 6), (3, 3, 3)), {}),
+    "fake_quantize_per_tensor_affine": lambda r: ((_f(r, 3, 4), 0.1, 2, -10, 10), {}),
+    "fake_quantize_per_channel_affine": lambda r: ((_f(r, 3, 4), _pos(r, 3),
+                                                    np.zeros(3, np.int32), 0, -10, 10), {}),
+    "hann_window": lambda r: ((8,), {}),
+    "hamming_window": lambda r: ((8,), {}),
+    "blackman_window": lambda r: ((8,), {}),
+    "bartlett_window": lambda r: ((8,), {}),
+    "kaiser_window": lambda r: ((8,), {}),
+    "histogramdd": lambda r: ((_f(r, 20, 2), 4), {}),
+    "as_tensor": lambda r: ((_f(r, 3),), {}),
+    "asarray": lambda r: ((_f(r, 3),), {}),
+    "range": lambda r: ((0, 5, 1), {}),
+    "native_norm": lambda r: ((_f(r, 5),), {}),
+    "cpu": lambda r: ((_f(r, 3),), {}),
 }
 
 # entries whose torch reference has a different name or needs the
@@ -244,6 +279,9 @@ TORCH_NAME = {
     "lu_solve": lambda b, lu, piv: torch.lu_solve(
         torch.as_tensor(b), torch.as_tensor(lu), torch.as_tensor(piv)),
     "adaptive_max_pool1d": F.adaptive_max_pool1d,
+    # aten::native_norm is sparse/CUDA-only on this CPU build; torch.norm is
+    # the same p-norm contract for dense inputs
+    "native_norm": lambda a: torch.norm(torch.as_tensor(a)),
     "poisson_nll_loss": F.poisson_nll_loss,
     "multilabel_margin_loss": F.multilabel_margin_loss,
     "multi_margin_loss": F.multi_margin_loss,
@@ -597,3 +635,112 @@ def test_dropout3d_unbatched_channel_mask(rng):
     for c in range(6):
         ch = out[c]
         assert np.all(ch == 0) or np.allclose(ch, 2.0)
+
+
+def test_wave8_dedicated(rng):
+    """wave-8 entries without a 1:1 CPU torch reference: geqrf/ormqr via
+    reconstruction, low-rank factorizations via singular values, distributed
+    batch-norm internals via the formula, shape-contract factories."""
+    # geqrf + ormqr: Q @ R reconstructs A
+    a = _f(rng, 5, 4)
+    h, tau = tt.jit(lambda a: ar.get_auto_symbol("geqrf")(a))(jnp.asarray(a))
+    r = np.triu(np.asarray(h))[:4, :]
+    qr_full = tt.jit(lambda h, tau, o: ar.get_auto_symbol("ormqr")(h, tau, o))(
+        h, tau, jnp.asarray(r))
+    np.testing.assert_allclose(np.asarray(qr_full), a, atol=1e-4)
+
+    # svd_lowrank / pca_lowrank: top singular values match full SVD
+    m = _f(rng, 8, 6)
+    u, s, v = tt.jit(lambda a: ar.get_auto_symbol("svd_lowrank")(a, 3))(jnp.asarray(m))
+    want_s = np.linalg.svd(m, compute_uv=False)[:3]
+    np.testing.assert_allclose(np.asarray(s), want_s, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(u @ jnp.diag(s) @ v.T),
+                               np.asarray((u * s) @ v.T), atol=1e-5)
+    _, s2, _ = tt.jit(lambda a: ar.get_auto_symbol("pca_lowrank")(a, 2))(jnp.asarray(m))
+    want2 = np.linalg.svd(m - m.mean(0), compute_uv=False)[:2]
+    np.testing.assert_allclose(np.asarray(s2), want2, atol=1e-4)
+
+    # gather_stats: two replicas with equal counts == stats of the union
+    x1, x2 = _f(rng, 10, 3), _f(rng, 10, 3)
+    mean = np.stack([x1.mean(0), x2.mean(0)])
+    invstd = np.stack([1 / np.sqrt(x1.var(0) + 1e-5), 1 / np.sqrt(x2.var(0) + 1e-5)])
+    gm, gi = tt.jit(lambda m, i: ar.get_auto_symbol("batch_norm_gather_stats_with_counts")(
+        None, m, i, None, None, 0.1, 1e-5, jnp.asarray([10.0, 10.0])))(
+        jnp.asarray(mean), jnp.asarray(invstd))
+    allx = np.concatenate([x1, x2], 0)
+    np.testing.assert_allclose(np.asarray(gm), allx.mean(0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gi), 1 / np.sqrt(allx.var(0) + 1e-5), atol=1e-4)
+
+    # backward_reduce / backward_elemt reproduce batch-norm input grads
+    xb = _f(rng, 4, 3, 5)
+    w = _pos(rng, 3)
+    go = _f(rng, 4, 3, 5)
+    mean_b = xb.mean((0, 2))
+    invstd_b = (1 / np.sqrt(xb.var((0, 2)) + 1e-5)).astype(np.float32)
+    sdy, sdyxmu, gw, gb = tt.jit(lambda g, x, m, i, w: ar.get_auto_symbol(
+        "batch_norm_backward_reduce")(g, x, m, i, w, True, True, True))(
+        jnp.asarray(go), jnp.asarray(xb), jnp.asarray(mean_b), jnp.asarray(invstd_b),
+        jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(sdy), go.sum((0, 2)), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), go.sum((0, 2)), atol=1e-4)
+    gi_el = tt.jit(lambda g, x, m, i, w, s1, s2: ar.get_auto_symbol(
+        "batch_norm_backward_elemt")(g, x, m, i, w, s1, s2, jnp.asarray([20.0])))(
+        jnp.asarray(go), jnp.asarray(xb), jnp.asarray(mean_b), jnp.asarray(invstd_b),
+        jnp.asarray(w), sdy, sdyxmu)
+    # reference grad via torch autograd on the normalization formula
+    xt = torch.as_tensor(xb).requires_grad_(True)
+    yt = ((xt - torch.as_tensor(mean_b).view(1, 3, 1))
+          * torch.as_tensor(invstd_b).view(1, 3, 1) * torch.as_tensor(w).view(1, 3, 1))
+    # batch-norm treats mean/invstd as functions of x; recompute them in torch
+    xt2 = torch.as_tensor(xb).requires_grad_(True)
+    mu = xt2.mean((0, 2), keepdim=True)
+    var = xt2.var((0, 2), unbiased=False, keepdim=True)
+    yt2 = (xt2 - mu) / torch.sqrt(var + 1e-5) * torch.as_tensor(w).view(1, 3, 1)
+    yt2.backward(torch.as_tensor(go))
+    np.testing.assert_allclose(np.asarray(gi_el), xt2.grad.numpy(), atol=1e-3)
+
+    # transposed convolution path of the aten entry
+    x = _f(rng, 2, 3, 6)
+    wt = _f(rng, 3, 4, 3)
+    got = tt.jit(lambda x, w: ar.get_auto_symbol("convolution")(
+        x, w, None, (2,), (1,), (1,), True, (1,), 1))(jnp.asarray(x), jnp.asarray(wt))
+    want = torch.convolution(torch.as_tensor(x), torch.as_tensor(wt), None,
+                             (2,), (1,), (1,), True, (1,), 1)
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-4)
+
+    # grouped forward convolution
+    xg = _f(rng, 2, 4, 8, 8)
+    wg = _f(rng, 6, 2, 3, 3)
+    got_g = tt.jit(lambda x, w: ar.get_auto_symbol("convolution")(
+        x, w, None, (1, 1), (0, 0), (1, 1), False, (0, 0), 2))(jnp.asarray(xg), jnp.asarray(wg))
+    want_g = torch.convolution(torch.as_tensor(xg), torch.as_tensor(wg), None,
+                               (1, 1), (0, 0), (1, 1), False, (0, 0), 2)
+    np.testing.assert_allclose(np.asarray(got_g), want_g.numpy(), atol=1e-4)
+
+    # shape-contract-only factories + identities
+    es = tt.jit(lambda: ar.get_auto_symbol("empty_strided")((2, 3), (3, 1)))()
+    assert tuple(es.shape) == (2, 3)
+    ep = tt.jit(lambda: ar.get_auto_symbol("empty_permuted")((2, 3), (1, 0)))()
+    assert tuple(ep.shape) == (2, 3)
+    ident = _f(rng, 3)
+    pm = tt.jit(lambda a: ar.get_auto_symbol("pin_memory")(a))(jnp.asarray(ident))
+    np.testing.assert_array_equal(np.asarray(pm), ident)
+
+    # F.upsample_bilinear (align_corners=True semantics)
+    xu = _f(rng, 1, 2, 4, 4)
+    got_u = tt.jit(lambda a: ar.get_auto_symbol("upsample_bilinear")(a, None, 2))(jnp.asarray(xu))
+    want_u = F.upsample_bilinear(torch.as_tensor(xu), scale_factor=2)
+    np.testing.assert_allclose(np.asarray(got_u), want_u.numpy(), atol=1e-4)
+
+
+def test_fallback_coverage_fully_accounted():
+    """Every reference auto-registered name is either native here or carries a
+    documented host-eager reason (FALLBACK_COVERAGE.md generator)."""
+    import os
+    from thunder_tpu.utils.fallback_coverage import coverage
+
+    if not os.path.exists("/root/reference/thunder/torch/default_torch_ops.py"):
+        pytest.skip("reference checkout not present")
+    rows, counts = coverage()
+    assert counts["unaccounted"] == 0, [k for k, v in rows.items() if v == "UNACCOUNTED"]
+    assert counts["ltorch"] + counts["auto"] >= 400

@@ -1,0 +1,60 @@
+"""Persistent XLA compilation cache (utils/compile_cache.py; BASELINE.json
+secondary metric — warm processes must skip the cold whole-step compile)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SNIPPET = """
+import time, jax.numpy as jnp
+import thunder_tpu as tt
+from thunder_tpu.utils.compile_cache import cache_dir
+def f(a, b):
+    return tt.ops.ltorch.sum(tt.ops.ltorch.matmul(a, b))
+t0 = time.perf_counter()
+float(tt.jit(f)(jnp.ones((64, 64)), jnp.ones((64, 64))))
+import json
+print(json.dumps({"dir": cache_dir(), "t": time.perf_counter() - t0}))
+"""
+
+
+def _run(env_extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.update(env_extra)
+    out = subprocess.run([sys.executable, "-c", _SNIPPET], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_cache_populates_and_hits(tmp_path):
+    cache = str(tmp_path / "xla-cache")
+    r1 = _run({"TT_COMPILE_CACHE_DIR": cache})
+    assert r1["dir"] == cache
+    entries = os.listdir(cache)
+    assert entries, "first process wrote no cache entries"
+    r2 = _run({"TT_COMPILE_CACHE_DIR": cache})
+    assert r2["dir"] == cache
+    # no new compilation artifacts needed beyond what process 1 wrote
+    assert set(os.listdir(cache)) == set(entries)
+
+
+def test_cache_disabled_by_env(tmp_path):
+    cache = str(tmp_path / "xla-cache-off")
+    r = _run({"TT_COMPILE_CACHE_DIR": cache, "TT_NO_COMPILE_CACHE": "1"})
+    assert r["dir"] is None
+    assert not os.path.exists(cache)
+
+
+def test_cache_defaults_off_on_cpu_backend():
+    # the test env runs JAX_PLATFORMS=cpu: without an explicit dir the cache
+    # must stay off (XLA:CPU AOT load warnings + cheap compiles)
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        pytest.skip("only meaningful under a cpu backend env")
+    r = _run({})
+    assert r["dir"] is None

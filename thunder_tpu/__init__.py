@@ -55,6 +55,12 @@ except Exception:  # pallas unavailable on this backend
 
 set_default_executors(_pallas_exs + [xlaex.ex])
 
+# persistent XLA compile cache: warm processes skip the multi-second
+# whole-step compile. Enabled lazily at the first jit() call so the backend
+# check sees post-import jax.config.update("jax_platforms") changes
+# (utils/compile_cache.py; TT_NO_COMPILE_CACHE=1 disables)
+from .utils.compile_cache import enable_persistent_cache  # noqa: E402
+
 __version__ = "0.1.0"
 
 
@@ -265,6 +271,8 @@ def jit(
     default direct proxy tracing is faster to compile for framework-native code.
     """
     from .nn.module import Module, ThunderModule
+
+    enable_persistent_cache()  # lazy: sees the backend the compile will use
 
     _is_torch_module = type(fn).__module__.partition(".")[0] == "torch" or any(
         c.__module__.startswith("torch.nn") for c in type(fn).__mro__[:-1]

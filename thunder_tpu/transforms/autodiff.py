@@ -258,6 +258,19 @@ def _erf_bwd(a, g):
     return prims.mul(g, prims.mul(clang.full_like(a, c), prims.exp(prims.neg(prims.mul(a, a)))))
 
 
+@register_augmented_forward(PrimIDs.ERFINV)
+def _erfinv_aug(a):
+    out = prims.erfinv(a)
+    return VJPResult(out, (out,))
+
+
+@register_backward(PrimIDs.ERFINV)
+def _erfinv_bwd(out, g):
+    # d/dx erfinv(x) = sqrt(pi)/2 * exp(erfinv(x)^2)
+    c = math.sqrt(math.pi) / 2.0
+    return prims.mul(g, prims.mul(clang.full_like(out, c), prims.exp(prims.mul(out, out))))
+
+
 @register_augmented_forward(PrimIDs.EXPM1)
 def _expm1_aug(a):
     out = prims.expm1(a)
@@ -615,6 +628,27 @@ def _taa_bwd(in_shape, in_dtype, indices, dim, g):
     return prims.scatter_add(zeros, indices, g, dim), None
 
 
+@register_augmented_forward(PrimIDs.INDEX_ADD)
+def _index_add_aug(a, indices, value, dim):
+    return VJPResult(prims.index_add(a, indices, value, dim), (indices, dim))
+
+
+@register_backward(PrimIDs.INDEX_ADD)
+def _index_add_bwd(indices, dim, g):
+    # out = a + scatter(value at indices): da = g, dvalue = gather of g
+    return g, None, prims.take(g, indices, dim)
+
+
+@register_augmented_forward(PrimIDs.SCATTER_ADD)
+def _scatter_add_aug(a, indices, value, dim):
+    return VJPResult(prims.scatter_add(a, indices, value, dim), (indices, dim))
+
+
+@register_backward(PrimIDs.SCATTER_ADD)
+def _scatter_add_bwd(indices, dim, g):
+    return g, None, prims.take_along_axis(g, indices, dim)
+
+
 @register_augmented_forward(PrimIDs.EMBEDDING)
 def _embedding_aug(indices, weight):
     indices = clang.ensure_proxy(indices)
@@ -657,6 +691,24 @@ def _sum_bwd(in_shape, dims, in_dtype, g):
     kept = tuple(d for d in range(len(in_shape)) if d not in dims)
     g = prims.convert_element_type(g, in_dtype) if g.dtype != in_dtype else g
     return prims.broadcast_in_dim(g, in_shape, kept)
+
+
+@register_augmented_forward(PrimIDs.PROD)
+def _prod_aug(a, dims, *, output_dtype=None):
+    out = prims.prod_prim(a, dims, output_dtype=output_dtype)
+    return VJPResult(out, (a, out, tuple(dims)))
+
+
+@register_backward(PrimIDs.PROD)
+def _prod_bwd(a, out, dims, g):
+    # d prod / d a_i = prod / a_i (torch semantics; matches jax for nonzero a)
+    kept = tuple(d for d in range(len(a.shape)) if d not in dims)
+    g_full = prims.broadcast_in_dim(g, a.shape, kept)
+    out_full = prims.broadcast_in_dim(out, a.shape, kept)
+    if g_full.dtype != a.dtype:
+        g_full = prims.convert_element_type(g_full, a.dtype)
+        out_full = prims.convert_element_type(out_full, a.dtype)
+    return prims.div(prims.mul(g_full, out_full), a)
 
 
 @register_augmented_forward(PrimIDs.LOG10)

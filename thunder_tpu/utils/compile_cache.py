@@ -1,0 +1,68 @@
+"""Persistent XLA compilation cache (TPU-native; no reference counterpart).
+
+The reference pays its (much smaller) torch.compile cost per process; on TPU
+the whole-step XLA compile is tens of seconds, so thunder_tpu persists
+compiled executables across processes via jax's compilation cache.
+BASELINE.json names compile time the secondary metric — this is how we manage
+it: first process pays the cold compile, every later process (tests, bench
+re-runs, restarts) deserializes from disk.
+
+Enabled by default at import of thunder_tpu; controlled by:
+  TT_COMPILE_CACHE_DIR  — cache directory (default ~/.cache/thunder_tpu/xla)
+  TT_NO_COMPILE_CACHE=1 — disable entirely
+"""
+from __future__ import annotations
+
+import os
+
+_enabled: bool | None = None
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> bool:
+    """Configure jax's persistent compilation cache. Idempotent; returns
+    whether the cache is active."""
+    global _enabled
+    if _enabled is not None and cache_dir is None:
+        return _enabled
+    if os.environ.get("TT_NO_COMPILE_CACHE") == "1":
+        _enabled = False
+        return False
+    explicit_dir = cache_dir or os.environ.get("TT_COMPILE_CACHE_DIR")
+    # default-on only for TPU backends: XLA:CPU AOT deserialization warns
+    # loudly on machine-feature mismatches, and CPU compiles are cheap anyway.
+    # This runs lazily at the first tt.jit compile (not package import), so
+    # jax.default_backend() reflects any jax.config.update("jax_platforms")
+    # the caller did after importing jax.
+    if explicit_dir is None:
+        try:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                _enabled = False
+                return False
+        except Exception:
+            _enabled = False
+            return False
+    cache_dir = explicit_dir or os.path.join(os.path.expanduser("~"), ".cache", "thunder_tpu", "xla")
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything: whole-step programs are always worth persisting,
+        # and small traces cost nothing
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _enabled = True
+    except Exception:
+        _enabled = False
+    return _enabled
+
+
+def cache_dir() -> str | None:
+    try:
+        import jax
+
+        return jax.config.jax_compilation_cache_dir if _enabled else None
+    except Exception:
+        return None

@@ -1,0 +1,150 @@
+"""Generate FALLBACK_COVERAGE.md: every name in the reference's
+auto-registered op list (thunder/torch/default_torch_ops.py:3) mapped to how
+this framework covers it — native ltorch symbol, native auto-catalog entry,
+or intentionally host-eager with the reason (VERDICT r3 #4: "emit a generated
+artifact listing every reference name that intentionally stays on the
+host-eager fallback and why").
+
+Run:  python -m thunder_tpu.utils.fallback_coverage [ref_ops_file] [out_md]
+"""
+from __future__ import annotations
+
+import re
+import sys
+
+# intentionally-excluded classes, by reason. Names not natively covered and
+# not listed here are flagged UNACCOUNTED (the generator fails loudly).
+EXCLUDED: dict[str, tuple[str, ...]] = {
+    "sparse tensors (no TPU/XLA sparse runtime; dense paths cover the math)": (
+        "coalesce", "col_indices", "ccol_indices", "crow_indices", "crow_indices_copy",
+        "row_indices", "row_indices_copy", "indices", "indices_copy", "values",
+        "values_copy", "dense_dim", "sparse_dim", "sparse_mask", "to_dense",
+        "to_sparse", "is_coalesced", "dsmm", "hsmm", "hspmm", "smm", "spmm",
+        "saddmm", "sspaddmm", "native_norm_sparse",
+    ),
+    "quantized-tensor runtime (NF4/int8/fp8 transforms are the TPU quantization story)": (
+        "int_repr", "choose_qparams_optimized", "fused_moving_avg_obs_fake_quant",
+    ),
+    "fbgemm x86 kernels (vendor-specific; TPU equivalent is the XLA matmul path)": (
+        "fbgemm_linear_fp16_weight", "fbgemm_linear_fp16_weight_fp32_activation",
+        "fbgemm_linear_int8_weight", "fbgemm_linear_int8_weight_fp32_activation",
+        "fbgemm_linear_quantize_weight", "fbgemm_pack_gemm_matrix_fp16",
+        "fbgemm_pack_quantized_matrix",
+    ),
+    "output shape depends on runtime values (torch interop covers these via the host-eager fallback)": (
+        "argwhere", "nonzero", "bincount", "unique", "unique_consecutive",
+        "masked_select",
+    ),
+    "stateful RNG sampler (stateless tracing cannot reproduce torch generator semantics; "
+    "key-accepting ltorch variants exist for dropout/bernoulli)": (
+        "binomial", "poisson", "native_dropout", "randint_like",
+        "fractional_max_pool2d", "fractional_max_pool2d_with_indices",
+        "fractional_max_pool3d", "fractional_max_pool3d_with_indices",
+    ),
+    "host/framework metadata (resolved natively by the interop frontend, not traced as ops)": (
+        "data_ptr", "numpy", "tolist", "is_set_to", "module_load", "retain_grad",
+        "is_contiguous", "is_conj", "is_neg", "is_inference", "is_nonzero",
+        "is_pinned", "is_shared", "is_distributed", "is_signed", "element_size",
+        "get_device", "ndimension", "nelement", "dim_order", "has_names",
+        "resize", "resize_as",
+    ),
+    "named-tensor API (torch experimental; no proxy-level named dims)": (
+        "align_as", "align_to", "refine_names", "rename",
+    ),
+    "no jax special-function implementation (scipy-only; would need a native kernel)": (
+        "special_airy_ai", "special_bessel_y0", "special_bessel_y1",
+    ),
+    "LAPACK routines without a jax lowering (LDL for symmetric-indefinite)": (
+        "linalg_ldl_factor", "linalg_ldl_factor_ex", "linalg_ldl_solve",
+    ),
+    "iterative eigensolver driver (torch implements it in python over matmuls; "
+    "users can run the same loop under tt.jit)": (
+        "lobpcg",
+    ),
+    "deprecated/removed in modern torch (raises there too)": (
+        "eig", "symeig", "lstsq", "solve",
+    ),
+    "autograd-internal entry points (this framework's autodiff derives batch-norm "
+    "backward natively; the *_elemt/_reduce pieces ARE registered)": (
+        "slice_inverse",
+    ),
+    "packed multi-head attention aten overload (covered by ltorch.multi_head_attention_forward "
+    "and the sdpa/flash path)": (
+        "_native_multi_head_attention",
+    ),
+    "3-D grid sampler (2-D grid_sample is registered; 3-D awaits a use case)": (
+        "grid_sampler_3d",
+    ),
+    "CUDA-only kernel-dispatch helpers": (
+        "adaptive_max_pool3d_with_indices_backward",
+    ),
+    "host-pinned memory / device-placement hints (no-ops under XLA's memory model, "
+    "identity entries registered for interop)": (),
+}
+
+
+def ref_names(path: str = "/root/reference/thunder/torch/default_torch_ops.py") -> set[str]:
+    src = open(path).read()
+    entries = re.findall(r"^\s+(torch[A-Za-z0-9_.]*)\s*,\s*$", src, re.M)
+
+    def canon(e: str) -> str:
+        parts = e.split(".")
+        if len(parts) > 2 and parts[1] in ("special", "fft", "linalg"):
+            return parts[1] + "_" + parts[-1]
+        return parts[-1]
+
+    return {canon(e) for e in entries}
+
+
+def coverage() -> tuple[dict[str, str], dict[str, int]]:
+    from ..ops import auto_register, ltorch
+
+    auto = set(auto_register.list_auto_ops())
+    lt = {n for n in dir(ltorch) if not n.startswith("_") and callable(getattr(ltorch, n))}
+    reasons = {n: reason for reason, ns in EXCLUDED.items() for n in ns}
+
+    rows: dict[str, str] = {}
+    counts = {"ltorch": 0, "auto": 0, "excluded": 0, "unaccounted": 0}
+    for name in sorted(ref_names()):
+        if name in auto:
+            rows[name] = "native: auto catalog"
+            counts["auto"] += 1
+        elif name in lt:
+            rows[name] = "native: ltorch symbol"
+            counts["ltorch"] += 1
+        elif name in reasons:
+            rows[name] = f"host-eager: {reasons[name]}"
+            counts["excluded"] += 1
+        else:
+            rows[name] = "UNACCOUNTED"
+            counts["unaccounted"] += 1
+    return rows, counts
+
+
+def main(out: str = "FALLBACK_COVERAGE.md") -> None:
+    from ..ops import auto_register
+
+    rows, counts = coverage()
+    n = len(rows)
+    with open(out, "w") as f:
+        f.write("# Reference auto-registered op coverage\n\n")
+        f.write("Generated by `python -m thunder_tpu.utils.fallback_coverage`. Maps every\n"
+                "canonical name in the reference's auto-registration list\n"
+                "(`thunder/torch/default_torch_ops.py:3`, 690 entries over the\n"
+                "torch/Tensor/nn.functional/special/fft/linalg namespaces, "
+                f"{n} unique canonical names)\nto its status here. "
+                f"Auto catalog size: {len(auto_register.list_auto_ops())} entries.\n\n")
+        f.write(f"**Native: {counts['ltorch'] + counts['auto']}/{n}** "
+                f"({counts['ltorch']} ltorch, {counts['auto']} auto-catalog) — "
+                f"**host-eager by design: {counts['excluded']}** — "
+                f"**unaccounted: {counts['unaccounted']}**\n\n")
+        f.write("| reference name | status |\n|---|---|\n")
+        for name, status in rows.items():
+            f.write(f"| `{name}` | {status} |\n")
+    if counts["unaccounted"]:
+        bad = [k for k, v in rows.items() if v == "UNACCOUNTED"]
+        raise SystemExit(f"UNACCOUNTED names (add to catalog or EXCLUDED): {bad}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
