@@ -89,7 +89,8 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     from thunder_tpu.models.litgpt import Config, GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
-    cfg = Config.from_name(model_name, block_size=T)
+    ckpt = os.environ.get("BENCH_CKPT") == "1"
+    cfg = Config.from_name(model_name, block_size=T, activation_checkpoint=ckpt)
     model = GPTForCausalLM(cfg)
     # bf16 mixed precision by default, matching the reference harness
     # (thunder/benchmarks/benchmark_litgpt.py precision default)
@@ -103,7 +104,18 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         from thunder_tpu.transforms.fp8_training import FP8TrainingTransform
 
         transforms.append(FP8TrainingTransform())
-    step = TrainStep(tt.jit(model, transforms=transforms), optim.AdamW(lr=1e-4))
+    if os.environ.get("BENCH_ROAD") == "gspmd":
+        # the compiler-partitioned road (parallel/gspmd.py) — on one chip
+        # this measures pure road overhead vs the explicit TrainStep path
+        from thunder_tpu.parallel import DistPlan, ParamStrategy, gspmd_step, make_mesh
+
+        tm = tt.jit(model, transforms=transforms)
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        plan = DistPlan(mesh, {k: [ParamStrategy("replicate", "dp")]
+                               for k in tm.get_parameters()}, ("dp",))
+        step = gspmd_step(tm, optim.AdamW(lr=1e-4), plan)
+    else:
+        step = TrainStep(tt.jit(model, transforms=transforms), optim.AdamW(lr=1e-4))
     rng = np.random.RandomState(0)
     idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
     tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
@@ -156,7 +168,8 @@ def _bench_handwritten(model_name: str, B: int, T: int, iters: int, warmup: int)
     from thunder_tpu.benchmarks import handwritten_jax as hw
     from thunder_tpu.models.litgpt import Config
 
-    cfg = Config.from_name(model_name, block_size=T)
+    ckpt = os.environ.get("BENCH_CKPT") == "1"
+    cfg = Config.from_name(model_name, block_size=T, activation_checkpoint=ckpt)
     compute = jnp.bfloat16 if os.environ.get("BENCH_PRECISION", "bf16") == "bf16" else jnp.float32
     params = hw.init_params(cfg)
     opt = hw.adamw_init(params)
@@ -178,7 +191,8 @@ def _bench_handwritten(model_name: str, B: int, T: int, iters: int, warmup: int)
     return {"tps": (B * T * iters) / dt, "loss": loss_val}
 
 
-def _run_phase(phase: str, model_name: str, B: int, T: int, iters: int) -> dict:
+def _run_phase(phase: str, model_name: str, B: int, T: int, iters: int,
+               ckpt: bool = False) -> dict:
     """Run one benchmark phase in a subprocess; returns its result JSON."""
     env = dict(os.environ)
     env["BENCH_PHASE"] = phase
@@ -186,6 +200,7 @@ def _run_phase(phase: str, model_name: str, B: int, T: int, iters: int) -> dict:
     env["BENCH_BATCH"] = str(B)
     env["BENCH_SEQLEN"] = str(T)
     env["BENCH_ITERS"] = str(iters)
+    env["BENCH_CKPT"] = "1" if ckpt else "0"
     out = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
                          capture_output=True, text=True, timeout=3000)
     if out.returncode != 0:
@@ -193,8 +208,8 @@ def _run_phase(phase: str, model_name: str, B: int, T: int, iters: int) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-def _bench_row(model_name: str, B: int, T: int, iters: int) -> dict:
-    fused = _run_phase("fused", model_name, B, T, iters)
+def _bench_row(model_name: str, B: int, T: int, iters: int, ckpt: bool = False) -> dict:
+    fused = _run_phase("fused", model_name, B, T, iters, ckpt)
     fused_tps = fused["tps"]
     tflops = fused_tps * fused["flops_per_token"] / 1e12
     mfu = tflops / fused["peak_tflops"]
@@ -202,15 +217,16 @@ def _bench_row(model_name: str, B: int, T: int, iters: int) -> dict:
     vs_baseline = None
     baseline_tps = None
     try:
-        baseline_tps = _run_phase("handwritten", model_name, B, T, iters)["tps"]
+        baseline_tps = _run_phase("handwritten", model_name, B, T, iters, ckpt)["tps"]
         vs_baseline = fused_tps / baseline_tps
     except Exception as e:
         print(f"# handwritten-jax baseline failed ({model_name}): {e}", file=sys.stderr)
         vs_baseline = 1.0
 
     peak_gb = fused.get("device_peak_gb") or fused.get("mem_gb")
+    extra = "+ckpt" if ckpt else ""
     return {
-        "metric": f"{model_name} pretrain tokens/sec/chip (B={B}, T={T}, fwd+bwd+adamw, "
+        "metric": f"{model_name} pretrain tokens/sec/chip (B={B}, T={T}, fwd+bwd+adamw{extra}, "
                   f"vs hand-written jax.jit of the same model)",
         "value": round(fused_tps, 1),
         "unit": "tokens/s",
@@ -244,13 +260,18 @@ def main():
     if "BENCH_MODEL" in os.environ:
         rows = (f"{os.environ['BENCH_MODEL']}:{os.environ.get('BENCH_BATCH', '4')}"
                 f":{os.environ.get('BENCH_SEQLEN', '2048')}")
+        if os.environ.get("BENCH_CKPT") == "1":
+            rows += ":ckpt"
     else:
-        rows = os.environ.get("BENCH_ROWS", "nanogpt-124m:8:1024,llama-350m:4:2048")
+        rows = os.environ.get(
+            "BENCH_ROWS", "nanogpt-124m:8:1024,llama-1b:1:2048:ckpt,llama-350m:4:2048")
     specs = rows.split(",")
     for i, spec in enumerate(specs):
-        name, B, T = spec.split(":")
+        parts = spec.split(":")
+        name, B, T = parts[0], parts[1], parts[2]
+        ckpt = "ckpt" in parts[3:]
         try:
-            print(json.dumps(_bench_row(name, int(B), int(T), iters)), flush=True)
+            print(json.dumps(_bench_row(name, int(B), int(T), iters, ckpt)), flush=True)
         except Exception as e:
             # a non-headline failure must not swallow the headline row
             print(f"# bench row {name} failed: {e}", file=sys.stderr)

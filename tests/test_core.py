@@ -363,3 +363,59 @@ def test_masked_fill_concrete_mask(rng):
     out = tt.jit(lambda a: ltorch.masked_fill(a, mask, 0.0))(x)
     want = np.where(np.asarray(mask), 0.0, np.asarray(x))
     np.testing.assert_allclose(np.asarray(out), want)
+
+
+class TestAliasGroupCacheKeys:
+    """Runtime alias groups in the jit cache key (reference
+    thunder/__init__.py:408-437): a call whose tensor args share a buffer
+    must not reuse the specialization compiled for distinct buffers."""
+
+    def test_aliased_numpy_args_get_own_specialization(self, rng):
+        import numpy as np
+
+        import thunder_tpu as tt
+        from thunder_tpu.ops import ltorch
+
+        cf = tt.jit(lambda a, b: ltorch.sum(a * b))
+        base = rng.randn(4, 4).astype(np.float32)
+        x = base[:2]
+        y = base[2:]
+        cf(x, y)               # distinct buffers... of the same base! -> aliased
+        cf(x.copy(), y.copy())  # truly distinct
+        from thunder_tpu import _alias_groups, _is_tensor_like
+        from thunder_tpu.core.pytree import tree_flatten
+
+        leaves, _ = tree_flatten(((x, y), {}))
+        mask = [_is_tensor_like(l) for l in leaves]
+        assert _alias_groups(leaves, mask) == ((0, 1),)
+        leaves2, _ = tree_flatten(((x.copy(), y.copy()), {}))
+        assert _alias_groups(leaves2, mask) == ()
+        # the two structures landed in different cache entries
+        assert cf._cs.cache_misses == 2
+
+    def test_same_object_twice_groups(self, rng):
+        import jax.numpy as jnp
+
+        import thunder_tpu as tt
+        from thunder_tpu import _alias_groups, _is_tensor_like
+        from thunder_tpu.core.pytree import tree_flatten
+
+        x = jnp.ones((3, 3))
+        leaves, _ = tree_flatten(((x, x), {}))
+        mask = [_is_tensor_like(l) for l in leaves]
+        assert _alias_groups(leaves, mask) == ((0, 1),)
+
+    def test_interop_identical_views_unify(self, rng):
+        import numpy as np
+        import torch
+
+        from thunder_tpu.interop.torch_frontend import compile_torch_module
+
+        class AddMod(torch.nn.Module):
+            def forward(self, a, b):
+                return a + b
+
+        cm = compile_torch_module(AddMod())
+        t = torch.randn(3, 3)
+        out = cm(t, t.view(3, 3))  # same storage, same layout -> one buffer
+        np.testing.assert_allclose(np.asarray(out), (t + t).numpy(), atol=1e-6)

@@ -130,6 +130,43 @@ def build_prologue(trc: TraceCtx, tensor_mask, leaves) -> TraceCtx:
     return pro
 
 
+def _tensor_storage_token(leaf):
+    """A token identifying the underlying buffer of a tensor-like arg, for
+    runtime alias-group detection (reference thunder/__init__.py:408-437
+    computes alias groups of call-time args per call). None = unknown
+    storage (treated as unaliased)."""
+    dp = getattr(leaf, "data_ptr", None)  # torch tensors
+    if callable(dp):
+        try:
+            return ("torch", dp())
+        except Exception:
+            return None
+    base = getattr(leaf, "base", None)  # numpy views carry .base
+    iface = getattr(base if base is not None else leaf, "__array_interface__", None)
+    if isinstance(iface, dict) and "data" in iface:
+        return ("np", iface["data"][0])
+    return None
+
+
+def _alias_groups(leaves, tensor_mask) -> tuple:
+    """Group signature of tensor leaves sharing a buffer: () when all args
+    are distinct (the common case, adds nothing to the key); otherwise a
+    tuple of index-groups, so a call with different aliasing structure gets
+    its own specialization instead of reusing a stale one."""
+    by_store: dict = {}
+    ti = 0
+    for leaf, is_t in zip(leaves, tensor_mask):
+        if not is_t:
+            continue
+        tok = _tensor_storage_token(leaf)
+        if tok is None:
+            tok = ("id", id(leaf))
+        by_store.setdefault(tok, []).append(ti)
+        ti += 1
+    groups = tuple(tuple(g) for g in by_store.values() if len(g) > 1)
+    return groups
+
+
 def _cache_key(leaves, tensor_mask) -> tuple:
     key = []
     for leaf, is_t in zip(leaves, tensor_mask):
@@ -141,6 +178,9 @@ def _cache_key(leaves, tensor_mask) -> tuple:
                 key.append(("S", leaf))
             except TypeError:
                 key.append(("S", repr(leaf)))
+    groups = _alias_groups(leaves, tensor_mask)
+    if groups:
+        key.append(("aliases", groups))
     return tuple(key)
 
 
@@ -398,10 +438,10 @@ def grad(cfn, argnums=0):
     return _grad(cfn, argnums=argnums)
 
 
-def value_and_grad(cfn, argnums=0):
+def value_and_grad(cfn, argnums=0, *, interpretation=None):
     from .transforms.autodiff import value_and_grad as _vag
 
-    return _vag(cfn, argnums=argnums)
+    return _vag(cfn, argnums=argnums, interpretation=interpretation)
 
 
 def examine(fn, *args, **kwargs):

@@ -123,63 +123,12 @@ def forward(cfg, params, idx, targets, cos, sin, compute_dtype=jnp.bfloat16):
 
     cos_t, sin_t = cos[:T], sin[:T]
     x = w("wte.weight")[idx]
+    use_ckpt = bool(getattr(cfg, "activation_checkpoint", False))
     for i in range(cfg.n_layer):
         blk = f"h.{i}"
-        h = _norm_f(cfg, x, params[f"{blk}.norm_1.weight"],
-                    params.get(f"{blk}.norm_1.bias"), cfg.norm_eps).astype(compute_dtype)
-        qkv = h @ w(f"{blk}.attn.attn.weight").T
-        if f"{blk}.attn.attn.bias" in params:
-            qkv = qkv + w(f"{blk}.attn.attn.bias")
-        qkv = qkv.reshape(B, T, ng, q_per_kv + 2, hs)
-        q = qkv[:, :, :, :q_per_kv].reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
-        k = qkv[:, :, :, q_per_kv: q_per_kv + 1].reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-        v = qkv[:, :, :, q_per_kv + 1:].reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-        q = _rope(q, cos_t, sin_t, cfg.rope_n_elem)
-        k = _rope(k, cos_t, sin_t, cfg.rope_n_elem)
-        if ng != nh:
-            k = jnp.repeat(k, q_per_kv, axis=1)
-            v = jnp.repeat(v, q_per_kv, axis=1)
-        # the attention a jax user writes today, strongest available first:
-        # jax's library pallas flash kernel (the composite materializes
-        # B·H·T² probabilities for backward — OOM at llama-350m B=4 T=2048
-        # on one 16 GB chip), then the fused composite, then manual softmax
-        lib_flash = _library_flash_attention()
-        score_bytes = B * nh * T * T * 2
-        big_attention = T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
-        if lib_flash is not None and big_attention and T % 128 == 0 and hs >= 64:
-            y = lib_flash(q.astype(compute_dtype), k.astype(compute_dtype),
-                          v.astype(compute_dtype), causal=True,
-                          sm_scale=1.0 / math.sqrt(hs))
-            y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
-        elif hasattr(jax.nn, "dot_product_attention"):
-            # rope promotes q/k to f32 (f32 cos/sin); the composite requires
-            # uniform dtypes
-            y = jax.nn.dot_product_attention(
-                q.astype(compute_dtype).transpose(0, 2, 1, 3),
-                k.astype(compute_dtype).transpose(0, 2, 1, 3),
-                v.astype(compute_dtype).transpose(0, 2, 1, 3),
-                scale=1.0 / math.sqrt(hs), is_causal=True)
-            y = y.reshape(B, T, nh * hs)
-        else:
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                           preferred_element_type=jnp.float32) / math.sqrt(hs)
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask, s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1).astype(compute_dtype)
-            y = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-            y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
-        y = y @ w(f"{blk}.attn.proj.weight").T
-        if f"{blk}.attn.proj.bias" in params:
-            y = y + w(f"{blk}.attn.proj.bias")
-        if cfg.parallel_residual:
-            h2 = _norm_f(cfg, x, params[f"{blk}.norm_2.weight"],
-                         params.get(f"{blk}.norm_2.bias"), cfg.norm_eps).astype(compute_dtype)
-            x = x + y + _mlp(cfg, params, blk, h2, w)
-        else:
-            x = x + y
-            h2 = _norm_f(cfg, x, params[f"{blk}.norm_2.weight"],
-                         params.get(f"{blk}.norm_2.bias"), cfg.norm_eps).astype(compute_dtype)
-            x = x + _mlp(cfg, params, blk, h2, w)
+        body = functools.partial(_block_body, cfg, params, blk, w, cos_t, sin_t,
+                                 compute_dtype, B, T)
+        x = jax.checkpoint(body)(x) if use_ckpt else body(x)
     x = _norm_f(cfg, x, params["ln_f.weight"], params.get("ln_f.bias"),
                 cfg.norm_eps).astype(compute_dtype)
     logits = x @ w("lm_head.weight").T
@@ -190,6 +139,67 @@ def forward(cfg, params, idx, targets, cos, sin, compute_dtype=jnp.bfloat16):
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, tgt[:, None], 1)[:, 0]
     return jnp.mean(lse - picked)
+
+
+def _block_body(cfg, params, blk, w, cos_t, sin_t, compute_dtype, B, T, x):
+    nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+    q_per_kv = nh // ng
+    h = _norm_f(cfg, x, params[f"{blk}.norm_1.weight"],
+                params.get(f"{blk}.norm_1.bias"), cfg.norm_eps).astype(compute_dtype)
+    qkv = h @ w(f"{blk}.attn.attn.weight").T
+    if f"{blk}.attn.attn.bias" in params:
+        qkv = qkv + w(f"{blk}.attn.attn.bias")
+    qkv = qkv.reshape(B, T, ng, q_per_kv + 2, hs)
+    q = qkv[:, :, :, :q_per_kv].reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
+    k = qkv[:, :, :, q_per_kv: q_per_kv + 1].reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    v = qkv[:, :, :, q_per_kv + 1:].reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    q = _rope(q, cos_t, sin_t, cfg.rope_n_elem)
+    k = _rope(k, cos_t, sin_t, cfg.rope_n_elem)
+    if ng != nh:
+        k = jnp.repeat(k, q_per_kv, axis=1)
+        v = jnp.repeat(v, q_per_kv, axis=1)
+    # the attention a jax user writes today, strongest available first:
+    # jax's library pallas flash kernel (the composite materializes
+    # B·H·T² probabilities for backward — OOM at llama-350m B=4 T=2048
+    # on one 16 GB chip), then the fused composite, then manual softmax
+    lib_flash = _library_flash_attention()
+    score_bytes = B * nh * T * T * 2
+    big_attention = T >= 4096 or (T >= 2048 and score_bytes >= 256 * 2**20)
+    if lib_flash is not None and big_attention and T % 128 == 0 and hs >= 64:
+        y = lib_flash(q.astype(compute_dtype), k.astype(compute_dtype),
+                      v.astype(compute_dtype), causal=True,
+                      sm_scale=1.0 / math.sqrt(hs))
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
+    elif hasattr(jax.nn, "dot_product_attention"):
+        # rope promotes q/k to f32 (f32 cos/sin); the composite requires
+        # uniform dtypes
+        y = jax.nn.dot_product_attention(
+            q.astype(compute_dtype).transpose(0, 2, 1, 3),
+            k.astype(compute_dtype).transpose(0, 2, 1, 3),
+            v.astype(compute_dtype).transpose(0, 2, 1, 3),
+            scale=1.0 / math.sqrt(hs), is_causal=True)
+        y = y.reshape(B, T, nh * hs)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / math.sqrt(hs)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(compute_dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
+    y = y @ w(f"{blk}.attn.proj.weight").T
+    if f"{blk}.attn.proj.bias" in params:
+        y = y + w(f"{blk}.attn.proj.bias")
+    if cfg.parallel_residual:
+        h2 = _norm_f(cfg, x, params[f"{blk}.norm_2.weight"],
+                     params.get(f"{blk}.norm_2.bias"), cfg.norm_eps).astype(compute_dtype)
+        x = x + y + _mlp(cfg, params, blk, h2, w)
+    else:
+        x = x + y
+        h2 = _norm_f(cfg, x, params[f"{blk}.norm_2.weight"],
+                     params.get(f"{blk}.norm_2.bias"), cfg.norm_eps).astype(compute_dtype)
+        x = x + _mlp(cfg, params, blk, h2, w)
+    return x
 
 
 def _mlp(cfg, params, blk, h, w):

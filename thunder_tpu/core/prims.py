@@ -43,6 +43,7 @@ class PrimIDs(Enum):
     UNPACK_CLOSURE = auto()
     UNPACK_ATTR = auto()
     UNPACK_ITEM = auto()
+    UNPACK_TENSOR_DATA = auto()
     # dtype/device movement
     CONVERT_ELEMENT_TYPE = auto()
     DEVICE_PUT = auto()
@@ -306,6 +307,17 @@ unpack_attr = make_prim(PrimIDs.UNPACK_ATTR, "unpack_attr", _unpack_out_meta,
                         tags=(OpTags.DONT_DCE,), python_impl=_unpack_attr_impl)
 unpack_item = make_prim(PrimIDs.UNPACK_ITEM, "unpack_item", _unpack_out_meta,
                         tags=(OpTags.DONT_DCE,), python_impl=_unpack_item_impl)
+
+
+def _unpack_tensor_data_impl(x):
+    # Parameter/buffer wrappers -> raw jax array (identity for plain arrays)
+    data = getattr(x, "data", None)
+    return data if data is not None and hasattr(x, "requires_grad") else x
+
+
+unpack_tensor_data = make_prim(PrimIDs.UNPACK_TENSOR_DATA, "unpack_tensor_data",
+                               _unpack_out_meta, tags=(OpTags.DONT_DCE,),
+                               python_impl=_unpack_tensor_data_impl)
 
 
 # ---------------------------------------------------------------------------

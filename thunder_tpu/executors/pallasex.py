@@ -40,7 +40,19 @@ register_executor(ex)
 # k-major blocks keep the MXU fed during the online-softmax accumulation
 DEFAULT_BLOCK_Q = int(os.environ.get("TT_FLASH_BLOCK_Q", "512"))
 DEFAULT_BLOCK_K = int(os.environ.get("TT_FLASH_BLOCK_K", "1024"))
+
+
+def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int):
+    """Block sizes are swept for bf16; 4-byte inputs (f32 paths, e.g. a
+    no-autocast train step) double every VMEM working set and blow the 16M
+    scoped limit — cap both blocks at 256 there (gcd keeps divisibility)."""
+    if jnp.dtype(q.dtype).itemsize >= 4:
+        block_q = math.gcd(min(block_q, 256), T)
+        block_k = math.gcd(min(block_k, 256), Tk)
+    return block_q, block_k
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # 1/ln 2: base-2 softmax folds this into the scale
+LN2 = 0.6931471805599453
 
 
 def _on_tpu() -> bool:
@@ -72,18 +84,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, caus
     q = q_ref[:]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
+    # base-2 softmax: fold log2(e) into the dot scale so the per-element
+    # softmax uses the VPU's native exp2 with no premultiply pass — the
+    # running max/sum track log2 units; lse converts back to natural log once
+    scale2 = scale * LOG2E
+
     def body(j, carry):
         o_acc, m, l = carry
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # (bq, bk)
+                                preferred_element_type=jnp.float32) * scale2  # (bq, bk)
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
         o_new = o_acc * corr[:, None] + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
@@ -101,7 +118,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, caus
     o, m, l = jax.lax.fori_loop(0, n_k, body, (o0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
+    lse_ref[:] = ((m + jnp.log2(l_safe)) * LN2)[:, None]
 
 
 def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
@@ -116,6 +133,7 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
     g = H // Hkv  # GQA group: kv head = q head // g (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk)
     grid = (B, H, T // block_q)
 
     o, lse = pl.pallas_call(
@@ -152,7 +170,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     qi = pl.program_id(2)
     q = q_ref[:]
     do = do_ref[:]
-    lse = lse_ref[:][:, 0]
+    lse2 = lse_ref[:][:, 0] * LOG2E  # natural-log lse -> log2 units
     delta = delta_ref[:][:, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -160,11 +178,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * (scale * LOG2E)
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp2(s - lse2[:, None])
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -196,14 +214,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         dk_acc, dv_acc = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
         delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
         s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * scale  # (bk, bq)
+                                  preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
         if causal:
             q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
             s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp(s_t - lse[None, :])
+        p_t = jnp.exp2(s_t - lse2[None, :])
         dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
                                               (((1,), (0,)), ((), ())),
                                               preferred_element_type=jnp.float32)
@@ -230,6 +248,7 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     g = H // Hkv  # GQA: dk/dv computed per q head, group-summed below
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk)
     if g > 1:
         # grouped-kv double buffering vmem guard; gcd keeps divisibility
         # under TT_FLASH_BLOCK_* overrides (a non-divisor block would
@@ -337,13 +356,13 @@ def _flash_rope_fwd_kernel(q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
                             sk_ref[pl.ds(j * block_k, block_k), :]).astype(k_ref.dtype)
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         ss = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32) * scale
+                                 preferred_element_type=jnp.float32) * (scale * LOG2E)
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             ss = jnp.where(k_pos <= q_pos, ss, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(ss, axis=1))
-        pp = jnp.exp(ss - m_new[:, None])
-        corr = jnp.exp(m - m_new)
+        pp = jnp.exp2(ss - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
         l_new = l * corr + jnp.sum(pp, axis=1)
         o_new = o_acc * corr[:, None] + jax.lax.dot_general(
             pp.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
@@ -359,7 +378,7 @@ def _flash_rope_fwd_kernel(q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
     o, m, l = jax.lax.fori_loop(0, n_k, body, (o0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe))[:, None]
+    lse_ref[:] = ((m + jnp.log2(l_safe)) * LN2)[:, None]
 
 
 def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scale=None,
@@ -372,6 +391,7 @@ def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scal
     g = H // Hkv  # GQA group (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T)
     cos = cos.astype(jnp.float32)
     sin = sin.astype(jnp.float32)
     o, lse = pl.pallas_call(
@@ -407,7 +427,7 @@ def _flash_rope_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(2)
     q = _rope_block(q_ref[:].astype(jnp.float32), cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
     do = do_ref[:]
-    lse = lse_ref[:][:, 0]
+    lse2 = lse_ref[:][:, 0] * LOG2E  # natural-log lse -> log2 units
     delta = delta_ref[:][:, 0]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -417,11 +437,11 @@ def _flash_rope_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             sk_ref[pl.ds(j * block_k, block_k), :]).astype(k_ref.dtype)
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         ss = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32) * scale
+                                 preferred_element_type=jnp.float32) * (scale * LOG2E)
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             ss = jnp.where(k_pos <= q_pos, ss, NEG_INF)
-        pp = jnp.exp(ss - lse[:, None])
+        pp = jnp.exp2(ss - lse2[:, None])
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = pp * (dp - delta[:, None]) * scale
@@ -452,14 +472,14 @@ def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         cq_ref[pl.ds(i * block_q, block_q), :],
                         sq_ref[pl.ds(i * block_q, block_q), :]).astype(q_ref.dtype)
         do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
         delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
         s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * scale
+                                  preferred_element_type=jnp.float32) * (scale * LOG2E)
         if causal:
             q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
             s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp(s_t - lse[None, :])
+        p_t = jnp.exp2(s_t - lse2[None, :])
         dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
                                               (((1,), (0,)), ((), ())),
                                               preferred_element_type=jnp.float32)
@@ -486,6 +506,7 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
     g = H // Hkv  # GQA: dk/dv per-q-head partials group-summed at the end
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T)
     if g > 1:
         # grouped kv blocks are revisited across q-head programs; Mosaic's
         # double-buffering pushes the 1024-row block ~160K over the 16M

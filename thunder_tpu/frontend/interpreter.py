@@ -240,12 +240,33 @@ _OPAQUE_MODULE_PREFIXES = (
 )
 
 
+class _ProvenanceIter:
+    """Iterator over a random-access sequence that yields items with
+    `item` provenance (so captured tensors inside iterated containers build
+    unpackable chains instead of opaque `op` roots)."""
+
+    __slots__ = ("obj", "prov", "i")
+
+    def __init__(self, obj, prov):
+        self.obj = obj
+        self.prov = prov
+        self.i = 0
+
+
 def _is_opaque_function(fn: Callable) -> bool:
     if not isinstance(fn, types.FunctionType):
         return True  # C functions, builtins, callables with __call__
     # the defining module's true name comes from the function's globals —
     # fn.__module__ lies under functools.wraps
     mod = (fn.__globals__.get("__name__") or "") if fn.__globals__ else ""
+    # thunder_tpu.nn / thunder_tpu.models are USER-LEVEL model code: their
+    # forward bodies must be interpreted so `self.<param>` loads get
+    # provenance-proxified into captured runtime inputs (the framework's own
+    # core/ops/executors stay opaque — proxies flow through them natively).
+    # transforms.remat's checkpoint wrapper is interpreted for the same
+    # reason: it calls back into module forwards.
+    if mod.startswith(("thunder_tpu.nn", "thunder_tpu.models", "thunder_tpu.transforms.remat")):
+        return bool(fn.__code__.co_flags & (0x80 | 0x200))
     if mod.partition(".")[0] in _OPAQUE_MODULE_PREFIXES:
         return True
     code = fn.__code__
@@ -367,6 +388,10 @@ class Interpreter:
         # cells reachable from the ROOT callable's __closure__ (id -> root
         # freevar name): only these are prologue-re-derivable captures
         self._root_cells: dict[int, str] = {}
+        # cells created while interpreting a closure-maker whose argument had
+        # unpackable provenance: id(cell) -> (cell, provenance, value) — lets
+        # LOAD_DEREF in the nested function re-attach the chain
+        self._cell_prov: dict[int, tuple] = {}
         # instruction logging (reference interpreter.py:457 — every interpreted
         # instruction recorded; rendered by print_last_interpreter_log)
         self.log: list[str] = []
@@ -461,7 +486,12 @@ class Interpreter:
         for name in code.co_cellvars:
             cell = types.CellType()
             if name in localsplus:  # argument that is also a cell (raw value)
-                cell.cell_contents = unwrap(localsplus.pop(name))
+                w = localsplus.pop(name)
+                cell.cell_contents = unwrap(w)
+                if isinstance(w, WrappedValue) and w.provenance.is_unpackable():
+                    # remember the argument's provenance for later LOAD_DEREFs
+                    # from nested interpreted functions (closure-makers)
+                    self._cell_prov[id(cell)] = (cell, w.provenance, cell.cell_contents)
             cells[name] = cell
         if fn.__closure__:
             for name, cell in zip(code.co_freevars, fn.__closure__):
@@ -627,6 +657,14 @@ class Interpreter:
         entry = self._root_cells.get(id(cell))
         if entry is not None and entry[1] is cell:
             frame.push(self._loaded(v, Provenance("closure", entry[0])))
+            return None
+        # cells created while INTERPRETING a closure-maker (e.g. a decorator
+        # like remat.checkpoint wrapping a provenance-tracked module) remember
+        # the wrapped argument's provenance — the load re-attaches it so the
+        # module's params still capture through the root chain
+        rec = self._cell_prov.get(id(cell))
+        if rec is not None and rec[0] is cell and rec[2] is v:
+            frame.push(self._loaded(v, rec[1]))
         else:
             frame.push(wrap(v, Provenance("op")))
         return None
@@ -974,11 +1012,31 @@ class Interpreter:
 
     # ---- control flow ----
     def op_GET_ITER(self, frame, fn, ins):
-        frame.push(wrap(iter(unwrap(frame.pop())), Provenance("op")))
+        obj_w = frame.pop()
+        obj = unwrap(obj_w)
+        prov = obj_w.provenance if isinstance(obj_w, WrappedValue) else OPAQUE_PROVENANCE
+        # iterating a provenance-tracked random-access sequence (list/tuple/
+        # ModuleList): keep per-item provenance so `for block in self.h` loads
+        # proxify like `self.h[i]` would
+        if (prov.is_unpackable() and not isinstance(obj, (str, bytes, dict))
+                and hasattr(obj, "__len__") and hasattr(obj, "__getitem__")):
+            frame.push(wrap(_ProvenanceIter(obj, prov), Provenance("op")))
+        else:
+            frame.push(wrap(iter(obj), Provenance("op")))
         return None
 
     def op_FOR_ITER(self, frame, fn, ins):
         it = unwrap(frame.peek(1))
+        if isinstance(it, _ProvenanceIter):
+            if it.i >= len(it.obj):
+                frame.pop()
+                idx = frame.offset_to_idx[ins.argval]
+                nxt = frame.instrs[idx]
+                return nxt.offset + 2 if nxt.opname == "END_FOR" else nxt.offset
+            i = it.i
+            it.i += 1
+            frame.push(self._loaded(it.obj[i], Provenance("item", i, it.prov)))
+            return None
         try:
             v = next(it)
         except StopIteration:
@@ -1070,8 +1128,12 @@ class Interpreter:
         if maybe_null is not NULL:
             # stack had [callable, self?]: rare; push back
             frame.push(maybe_null)
+        # keep the callee's provenance: a bound method's `self` chains back to
+        # the captured root through it (same as op_CALL)
+        prov = callee.provenance if isinstance(callee, WrappedValue) else OPAQUE_PROVENANCE
         frame.push(self.call(callee, [wrap(a, Provenance("op")) for a in args],
-                             {k: wrap(v, Provenance("op")) for k, v in kwargs.items()}))
+                             {k: wrap(v, Provenance("op")) for k, v in kwargs.items()},
+                             prov))
         return None
 
     def op_CALL_INTRINSIC_1(self, frame, fn, ins):

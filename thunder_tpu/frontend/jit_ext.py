@@ -141,7 +141,8 @@ class GeneralJitCtx:
 def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
                 lookasides: dict | None = None,
                 symbolic_numbers: bool = False,
-                record_log: bool = False) -> tuple[JitResults, Any, list, list]:
+                record_log: bool = False,
+                grad_mask: Sequence[bool] | None = None) -> tuple[JitResults, Any, list, list]:
     """Interpret fn over proxies, producing prologue + computation traces.
 
     Returns (JitResults, treedef, tensor_mask, leaves) — same surface as
@@ -165,9 +166,12 @@ def general_jit(fn: Callable, args, kwargs, *, sharp_edges: str = "allow",
     number_proxies: list[NumberProxy] = []
     pinned: set[str] = set()
     with tracectx(trc):
-        for leaf in leaves:
+        for li, leaf in enumerate(leaves):
             if _is_tensor_like(leaf):
-                p = proxy_from_jax(leaf, requires_grad=bool(getattr(leaf, "requires_grad", False)))
+                rg = bool(getattr(leaf, "requires_grad", False))
+                if grad_mask is not None and li < len(grad_mask):
+                    rg = rg or bool(grad_mask[li])
+                p = proxy_from_jax(leaf, requires_grad=rg)
                 proxy_leaves.append(p)
                 tensor_mask.append(True)
             elif symbolic_numbers and isinstance(leaf, (int, float)) and not isinstance(leaf, bool):
@@ -266,7 +270,10 @@ def _build_prologue(fn: Callable, arg_proxies: Sequence[TensorProxy], ctx: Gener
             q = TensorProxy(cap.proxy.name, shape=cap.proxy.shape, dtype=cap.proxy.dtype,
                             device=cap.proxy.device)
             pro.add_name(q.name)
-            emit_chain(cap.provenance, q)
+            raw = emit_chain(cap.provenance, None)
+            # Parameter/buffer wrappers (nn modules) -> raw array for the
+            # computation; identity for plain captured arrays
+            pro.add_bound_symbol(prims.unpack_tensor_data.bind(raw, output=q))
             prims.check_tensor_shape_and_metadata(q, cap.proxy.shape, cap.proxy.dtype,
                                                   str(cap.proxy.device))
             cap_outs.append(q)

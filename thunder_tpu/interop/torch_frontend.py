@@ -1116,6 +1116,15 @@ class TorchTracedModule:
                 return TraceTensor(v)
             if hasattr(v, "shape") and hasattr(v, "dtype") and not isinstance(v, torch.Tensor):
                 return TraceTensor(clang.constant(v))
+            # containers recurse: KV caches arrive as tuples-of-tuples of
+            # tensors; a raw TensorProxy leaking into torch code would
+            # surface tt dtypes/attrs where torch types are expected
+            if isinstance(v, tuple) and hasattr(v, "_fields"):
+                return type(v)(*(wrap_leaf(e) for e in v))
+            if isinstance(v, (tuple, list)):
+                return type(v)(wrap_leaf(e) for e in v)
+            if isinstance(v, dict):
+                return {k: wrap_leaf(e) for k, e in v.items()}
             return v
 
         wrapped_state = {k: wrap_leaf(v) for k, v in params.items()}
@@ -1170,9 +1179,18 @@ class CompiledTorchModule:
     def __call__(self, *args, **kwargs):
         from collections.abc import Mapping
 
+        # identical views of one torch storage (same ptr/shape/stride) map to
+        # ONE jax array object, so the jit cache's alias-group key sees the
+        # aliasing that jnp.asarray's device copy would otherwise erase
+        # (reference thunder/__init__.py:408-437 runtime alias groups)
+        seen: dict = {}
+
         def conv(x):
             if isinstance(x, torch.Tensor):
-                return torch_to_jax(x)
+                tok = (x.data_ptr(), tuple(x.shape), tuple(x.stride()), x.dtype)
+                if tok not in seen:
+                    seen[tok] = torch_to_jax(x)
+                return seen[tok]
             if isinstance(x, tuple) and hasattr(x, "_fields"):  # NamedTuple
                 return type(x)(*(conv(e) for e in x))
             if isinstance(x, (tuple, list)):

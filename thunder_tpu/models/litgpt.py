@@ -94,6 +94,14 @@ configs: dict[str, dict] = {
         n_layer=24, n_head=16, n_embd=1024, intermediate_size=2816,
         norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=10000,
     ),
+    # 1.02B-param Llama-class config (width 2048, head_dim 128, GQA 4 groups,
+    # vocab 32k): the largest round shape whose AdamW-f32 state (~12.2 GB)
+    # plus remat'd activations trains on one 16 GB chip at B=1, T=2048
+    "llama-1b": dict(
+        name="llama-1b", block_size=2048, vocab_size=32000, padded_vocab_size=32000,
+        n_layer=20, n_head=16, n_query_groups=4, n_embd=2048, intermediate_size=5504,
+        norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP", rope_base=10000,
+    ),
     "Llama-2-7b-hf": dict(
         name="Llama-2-7b-hf", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
         n_layer=32, n_head=32, n_embd=4096, intermediate_size=11008,

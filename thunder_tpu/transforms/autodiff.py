@@ -1358,6 +1358,7 @@ class _VAGEntry(NamedTuple):
     treedef: Any
     tensor_mask: tuple
     effect_keys: tuple = ()  # (owner, name) epilogue targets
+    prologue_fn: Callable | None = None  # interpreter-frontend acquisition only
 
 
 class ThunderValueAndGrad(EpilogueMixin):
@@ -1368,10 +1369,12 @@ class ThunderValueAndGrad(EpilogueMixin):
     with the ThunderFunction autograd bridge (torch_autograd.py:17) — TPU-
     native there is no runtime autograd tape, so the API is functional."""
 
-    def __init__(self, fn: Callable, argnums=None, transforms: Sequence = ()):
+    def __init__(self, fn: Callable, argnums=None, transforms: Sequence = (),
+                 interpretation: str | None = None):
         self.fn = fn
         self.argnums = (argnums,) if isinstance(argnums, int) else (tuple(argnums) if argnums is not None else None)
         self.transforms = list(transforms)
+        self.interpretation = interpretation
         self._cache: dict = {}
         self._cs = None  # CompileStats of last compile
 
@@ -1403,7 +1406,19 @@ class ThunderValueAndGrad(EpilogueMixin):
         grad_mask = self._grad_mask(args, kwargs)
 
         t0 = _time.perf_counter_ns()
-        trc, treedef, tensor_mask, leaves = acquire_trace(self.fn, args, kwargs, grad_mask=grad_mask)
+        prologue_fn = None
+        if self.interpretation is not None:
+            # bytecode-interpreter acquisition (reference framework.py:381-472
+            # runs grads under every frontend): the prologue unpacks user
+            # tensors + captured closure/module tensors into computation args
+            from ..frontend.jit_ext import general_jit
+
+            res, treedef, tensor_mask, leaves = general_jit(
+                self.fn, args, kwargs, grad_mask=grad_mask)
+            trc = res.computation_trc
+            prologue_fn = res.prologue_trc.python_callable()
+        else:
+            trc, treedef, tensor_mask, leaves = acquire_trace(self.fn, args, kwargs, grad_mask=grad_mask)
         cs.last_trace_tracing_time_ns = _time.perf_counter_ns() - t0
 
         t1 = _time.perf_counter_ns()
@@ -1430,7 +1445,8 @@ class ThunderValueAndGrad(EpilogueMixin):
         grad_positions = tuple(arg_name_to_pos[n] for n in fb.grad_arg_names)
         entry = _VAGEntry(fwd_fn, bwd_fn, fwd_claimed, bwd_claimed, grad_positions, treedef,
                           tuple(tensor_mask),
-                          tuple((o, n) for o, n, _ in getattr(trc, "side_effects", ())))
+                          tuple((o, n) for o, n, _ in getattr(trc, "side_effects", ())),
+                          prologue_fn)
         self._cache[key] = entry
         return entry
 
@@ -1462,6 +1478,8 @@ class ThunderValueAndGrad(EpilogueMixin):
         if entry is None:
             entry = self._compile(args, kwargs, key)
         tensor_leaves = [_unwrap(l) for l, m in zip(leaves, tensor_mask) if m]
+        if entry.prologue_fn is not None:
+            tensor_leaves = entry.prologue_fn(*tensor_leaves)
         out, saved = entry.fwd_fn(*tensor_leaves)
         if entry.effect_keys:
             out, effects = out
@@ -1483,9 +1501,14 @@ class ThunderValueAndGrad(EpilogueMixin):
         return out, grads
 
 
-def value_and_grad(fn, argnums=None):
-    """(value, grads) over a callable, Module, or compiled function."""
+def value_and_grad(fn, argnums=None, *, interpretation=None):
+    """(value, grads) over a callable, Module, or compiled function.
+
+    interpretation="python interpreter" acquires the program through the
+    bytecode-interpreter frontend (closure/module tensors captured via
+    provenance-built prologues) instead of direct proxy tracing."""
     from .. import ThunderCompiledFunction
+    from ..frontend.compiled import InterpretedFunction
     from ..nn.module import Module, ThunderModule
 
     if isinstance(fn, ThunderModule):
@@ -1496,9 +1519,12 @@ def value_and_grad(fn, argnums=None):
         return ModuleValueAndGrad(jit(fn))
     if type(fn).__name__ == "CompiledTorchModule":  # torch-frontend wrapper
         return TorchModuleValueAndGrad(fn)
+    if isinstance(fn, InterpretedFunction):
+        return ThunderValueAndGrad(fn.fn, argnums, transforms=fn.transforms,
+                                   interpretation="python interpreter")
     if isinstance(fn, ThunderCompiledFunction):
         fn = fn._cd.fn
-    return ThunderValueAndGrad(fn, argnums)
+    return ThunderValueAndGrad(fn, argnums, interpretation=interpretation)
 
 
 def grad(fn, argnums=None):
