@@ -42,11 +42,13 @@ DEFAULT_BLOCK_Q = int(os.environ.get("TT_FLASH_BLOCK_Q", "512"))
 DEFAULT_BLOCK_K = int(os.environ.get("TT_FLASH_BLOCK_K", "1024"))
 
 
-def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int):
-    """Block sizes are swept for bf16; 4-byte inputs (f32 paths, e.g. a
-    no-autocast train step) double every VMEM working set and blow the 16M
-    scoped limit — cap both blocks at 256 there (gcd keeps divisibility)."""
-    if jnp.dtype(q.dtype).itemsize >= 4:
+def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int, *extra):
+    """Block sizes are swept for bf16; 4-byte operands (f32 paths: a
+    no-autocast train step, or mixed-precision rewrites that leave SOME of
+    q/k/v/do f32) double the VMEM working set and blow the 16M scoped limit —
+    cap both blocks at 256 there (gcd keeps divisibility)."""
+    widest = max(jnp.dtype(t.dtype).itemsize for t in (q,) + tuple(extra))
+    if widest >= 4:
         block_q = math.gcd(min(block_q, 256), T)
         block_k = math.gcd(min(block_k, 256), Tk)
     return block_q, block_k
@@ -133,7 +135,7 @@ def flash_attention_forward(q, k, v, *, causal: bool = True, scale=None,
     g = H // Hkv  # GQA group: kv head = q head // g (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
-    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk, k, v)
     grid = (B, H, T // block_q)
 
     o, lse = pl.pallas_call(
@@ -197,58 +199,80 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                          block_q: int, causal: bool, scale: float):
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                          dk_scr, dv_scr, *, causal: bool, scale: float, g: int, n_i: int):
+    # GQA-aware, VMEM-bounded: grid (B, Hkv, T//block_k, T//block_q) streams
+    # q/do in (g, block_q, D) tiles (innermost-fastest on the TPU's
+    # sequential grid); dk/dv accumulate in VMEM scratch across the i axis
+    # and write ONCE at the last i — kv-grad HBM stays (B, Hkv, T, D), not
+    # g× (advisor r3 finding), with working set independent of T and g.
     block_k, D = k_ref.shape
-    T = q_ref.shape[0]
+    block_q = q_ref.shape[1]
     ki = pl.program_id(2)
-    k_blk = k_ref[:]
-    v_blk = v_ref[:]
-    # work in the TRANSPOSED orientation (rows = k positions): every dot then
-    # contracts lhs dim 1 against rhs dim 0/1 naturally — the straight
-    # orientation needs pᵀ/dsᵀ for dv/dk, and those in-kernel transposes of
-    # (block_q, block_k) tiles cost more than the matmuls themselves
-    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+    ii = pl.program_id(3)
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
-        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
-        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
-        if causal:
-            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
-            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp2(s_t - lse2[None, :])
-        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                              (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=jnp.float32)  # (bk, bq)
-        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+    @pl.when(ii == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    z = jnp.zeros((block_k, D), jnp.float32)
-    i0 = (ki * block_k) // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(i0, T // block_q, body, (z, z))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    # causal skip: the (j, i) tile contributes only when some q_pos >= k_pos
+    live = (ki * block_k <= (ii + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
+        # work in the TRANSPOSED orientation (rows = k positions): every dot
+        # then contracts lhs dim 1 against rhs dim 0/1 naturally — the
+        # straight orientation needs pᵀ/dsᵀ for dv/dk, and those in-kernel
+        # transposes of (block_q, block_k) tiles cost more than the matmuls
+        k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+        q_pos_t = ii * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        dk_acc = dk_scr[:]
+        dv_acc = dv_scr[:]
+        for h in range(g):  # static unroll over the q-head group
+            q = q_ref[h]
+            do = do_ref[h]
+            lse2 = lse_ref[h][:, 0] * LOG2E
+            delta = delta_ref[h][:, 0]
+            s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
+            if causal:
+                s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+            p_t = jnp.exp2(s_t - lse2[None, :])
+            dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                                  (((1,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+            dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                       preferred_element_type=jnp.float32)  # (bk, bq)
+            ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+            dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_acc
+        dv_scr[:] = dv_acc
+
+    @pl.when(ii == n_i - 1)
+    def _write():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=None,
                              block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if jnp.dtype(do.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
+        # fp8/mixed rewrites can hand a f32 cotangent to a bf16 attention:
+        # matching q's precision keeps the swept bf16 block sizes (delta is
+        # accumulated in f32 regardless)
+        do = do.astype(q.dtype)
     B, H, T, D = q.shape
     Tk = k.shape[2]
     Hkv = k.shape[1]
     g = H // Hkv  # GQA: dk/dv computed per q head, group-summed below
     block_q = min(block_q, T)
     block_k = min(block_k, Tk)
-    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk, k, v, do)
     if g > 1:
         # grouped-kv double buffering vmem guard; gcd keeps divisibility
         # under TT_FLASH_BLOCK_* overrides (a non-divisor block would
@@ -274,32 +298,38 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
 
+    # q heads grouped per kv head: view q/do/lse/delta as (B, Hkv, g, T, ...)
+    qg = q.reshape(B, Hkv, g, T, D)
+    dog = do.reshape(B, Hkv, g, T, D)
+    lseg = lse4.reshape(B, Hkv, g, T, 1)
+    deltag = delta4.reshape(B, Hkv, g, T, 1)
+    n_i = T // block_q
+    scratch = []
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((block_k, D), jnp.float32),
+                   pltpu.VMEM((block_k, D), jnp.float32)]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
-        grid=(B, H, Tk // block_k),
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, scale=scale, g=g, n_i=n_i),
+        grid=(B, Hkv, Tk // block_k, n_i),
         in_specs=[
-            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, j, i: (b, hk, 0, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Tk, D), v.dtype),
         ],
+        scratch_shapes=scratch,
         interpret=_interpret(),
-    )(q, k, v, do, lse4, delta4)
-    if g > 1:
-        # per-q-head partials -> per-kv-head grads (the dkv grid runs over q
-        # heads; writing shared kv outputs from grouped programs would race)
-        dk = jnp.sum(dk.reshape(B, Hkv, g, Tk, D), axis=2)
-        dv = jnp.sum(dv.reshape(B, Hkv, g, Tk, D), axis=2)
+    )(qg, k, v, dog, lseg, deltag)
     return dq, dk, dv
 
 
@@ -391,7 +421,7 @@ def flash_rope_attention_forward(q, k, v, cos, sin, *, causal: bool = True, scal
     g = H // Hkv  # GQA group (1 for MHA)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
-    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T, k, v)
     cos = cos.astype(jnp.float32)
     sin = sin.astype(jnp.float32)
     o, lse = pl.pallas_call(
@@ -457,56 +487,75 @@ def _flash_rope_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                               cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref, *,
-                               block_q: int, causal: bool, scale: float):
+                               cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref,
+                               dk_scr, dv_scr, *, causal: bool, scale: float,
+                               g: int, n_i: int):
+    # GQA-aware, VMEM-bounded (see _flash_bwd_dkv_kernel): 4-D grid streams
+    # (g, block_q, D) q/do tiles, scratch accumulates dk/dv across i, the
+    # rope VJP rotation applies once at the final write
     block_k, D = k_ref.shape
-    T = q_ref.shape[0]
+    block_q = q_ref.shape[1]
     ki = pl.program_id(2)
-    k_blk = _rope_block(k_ref[:].astype(jnp.float32), ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
-    v_blk = v_ref[:]
-    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+    ii = pl.program_id(3)
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        q = _rope_block(q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32),
-                        cq_ref[pl.ds(i * block_q, block_q), :],
-                        sq_ref[pl.ds(i * block_q, block_q), :]).astype(q_ref.dtype)
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
-        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
-        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * (scale * LOG2E)
-        if causal:
-            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
-            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp2(s_t - lse2[None, :])
-        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                              (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+    @pl.when(ii == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    z = jnp.zeros((block_k, D), jnp.float32)
-    i0 = (ki * block_k) // block_q if causal else 0
-    dk_r, dv = jax.lax.fori_loop(i0, T // block_q, body, (z, z))
-    dk_ref[:] = _rope_vjp_block(dk_r, ck_ref[:], sk_ref[:]).astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    live = (ki * block_k <= (ii + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = _rope_block(k_ref[:].astype(jnp.float32), ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
+        v_blk = v_ref[:]
+        k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+        q_pos_t = ii * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        dk_acc = dk_scr[:]
+        dv_acc = dv_scr[:]
+        for h in range(g):  # static unroll over the q-head group
+            q = _rope_block(q_ref[h].astype(jnp.float32),
+                            cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
+            do = do_ref[h]
+            lse2 = lse_ref[h][:, 0] * LOG2E
+            delta = delta_ref[h][:, 0]
+            s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32) * (scale * LOG2E)
+            if causal:
+                s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+            p_t = jnp.exp2(s_t - lse2[None, :])
+            dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                                  (((1,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+            dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+            dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_acc
+        dv_scr[:] = dv_acc
+
+    @pl.when(ii == n_i - 1)
+    def _write():
+        dk_ref[:] = _rope_vjp_block(dk_scr[:], ck_ref[:], sk_ref[:]).astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool = True,
                                   scale=None, block_q: int = DEFAULT_BLOCK_Q,
                                   block_k: int = DEFAULT_BLOCK_K):
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if jnp.dtype(do.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
+        # fp8/mixed rewrites can hand a f32 cotangent to a bf16 attention:
+        # matching q's precision keeps the swept bf16 block sizes (delta is
+        # accumulated in f32 regardless)
+        do = do.astype(q.dtype)
     B, H, T, D = q.shape
     Hkv = k.shape[1]
     g = H // Hkv  # GQA: dk/dv per-q-head partials group-summed at the end
     block_q = min(block_q, T)
     block_k = min(block_k, T)
-    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T, k, v, do)
     if g > 1:
         # grouped kv blocks are revisited across q-head programs; Mosaic's
         # double-buffering pushes the 1024-row block ~160K over the 16M
@@ -539,34 +588,42 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
 
+    qg = q.reshape(B, Hkv, g, T, D)
+    dog = do.reshape(B, Hkv, g, T, D)
+    lseg = lse4.reshape(B, Hkv, g, T, 1)
+    deltag = delta4.reshape(B, Hkv, g, T, 1)
+    n_i = T // block_q
+    scratch = []
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((block_k, D), jnp.float32),
+                   pltpu.VMEM((block_k, D), jnp.float32)]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_rope_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
-        grid=(B, H, T // block_k),
+        functools.partial(_flash_rope_bwd_dkv_kernel, causal=causal,
+                          scale=scale, g=g, n_i=n_i),
+        grid=(B, Hkv, T // block_k, n_i),
         in_specs=[
-            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
-            pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
-            pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
-            pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
-            pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, j, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, hk, j, i: (i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, hk, j, i: (i, 0)),
+            pl.BlockSpec((block_k, D), lambda b, hk, j, i: (j, 0)),
+            pl.BlockSpec((block_k, D), lambda b, hk, j, i: (j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
+            pl.BlockSpec((None, None, block_k, D), lambda b, hk, j, i: (b, hk, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, T, D), v.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, T, D), v.dtype),
         ],
+        scratch_shapes=scratch,
         interpret=_interpret(),
-    )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
-    if g > 1:
-        dk = jnp.sum(dk.reshape(B, Hkv, g, T, D), axis=2)
-        dv = jnp.sum(dv.reshape(B, Hkv, g, T, D), axis=2)
+    )(qg, k, v, dog, lseg, deltag, cos, sin, cos, sin)
     return dq, dk, dv
 
 
