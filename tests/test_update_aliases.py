@@ -1,0 +1,128 @@
+"""In-place / aliasing functionalization (reference
+thunder/tests/test_update_aliases.py): acquisition-time redirects under the
+interpreter frontend, interop in-place methods, buffer-mutation epilogues,
+and runtime alias-group cache keys."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.core import prims
+from thunder_tpu.ops import ltorch
+
+
+class TestInterpreterRedirects:
+    """The interpreter's redirect table: a functional update to a traced
+    tensor is observed by every later read of any alias, and the caller's
+    input array is never mutated."""
+
+    def test_setitem_observed_by_later_reads(self, rng):
+        def f(x, v):
+            y = ltorch.mul(x, 1.0)
+            y[1:3] = v
+            return ltorch.sum(y) + ltorch.sum(y * 0 + y)  # two reads post-update
+
+        x = jnp.asarray(rng.randn(5).astype(np.float32))
+        v = jnp.asarray(np.array([10.0, 20.0], np.float32))
+        got = float(tt.jit(f, interpretation="python interpreter")(x, v))
+        y_np = np.asarray(x).copy()
+        y_np[1:3] = np.asarray(v)
+        np.testing.assert_allclose(got, 2 * y_np.sum(), atol=1e-5)
+        # caller's buffer untouched (functionalization, not mutation)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(x))
+
+    def test_stale_alias_in_container_sees_update(self, rng):
+        def f(x, v):
+            y = ltorch.mul(x, 1.0)
+            box = [y]          # alias stored BEFORE the update
+            y[0:1] = v
+            return ltorch.sum(box[0])  # stale container read must see it
+
+        x = jnp.asarray(rng.randn(4).astype(np.float32))
+        v = jnp.asarray(np.array([7.0], np.float32))
+        got = float(tt.jit(f, interpretation="python interpreter")(x, v))
+        want = float(np.asarray(x)[1:].sum() + 7.0)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_setitem_prim_grads_flow(self, rng):
+        def f(c, nv):
+            c2 = prims.copy_with_setitem(c, slice(1, 3), nv)
+            return ltorch.sum(c2 * c2)
+
+        import jax
+
+        c = jnp.asarray(rng.randn(5).astype(np.float32))
+        nv = jnp.asarray(rng.randn(2).astype(np.float32))
+        _, grads = tt.value_and_grad(f, argnums=(0, 1))(c, nv)
+
+        def ref(c, nv):
+            c2 = c.at[1:3].set(nv)
+            return jnp.sum(c2 * c2)
+
+        rg = jax.grad(ref, argnums=(0, 1))(c, nv)
+        for g, r in zip(grads[0], rg):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-5)
+
+
+class TestInteropInPlace:
+    def test_add__functionalizes(self, rng):
+        import torch
+
+        from thunder_tpu.interop.torch_frontend import compile_torch_module
+
+        class M(torch.nn.Module):
+            def forward(self, x):
+                y = x.clone()
+                y.add_(1.0)
+                y.mul_(2.0)
+                return y
+
+        x = torch.randn(3, 4)
+        cm = compile_torch_module(M())
+        np.testing.assert_allclose(np.asarray(cm(x)), ((x + 1) * 2).numpy(), atol=1e-5)
+
+    def test_buffer_mutation_persists_across_calls(self, rng):
+        import torch
+
+        from thunder_tpu.interop.torch_frontend import compile_torch_module
+
+        class Counter(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.register_buffer("n", torch.zeros(()))
+
+            def forward(self, x):
+                self.n.add_(1.0)
+                return x * self.n
+
+        cm = compile_torch_module(Counter())
+        x = torch.ones(3)
+        np.testing.assert_allclose(np.asarray(cm(x)), [1, 1, 1], atol=0)
+        np.testing.assert_allclose(np.asarray(cm(x)), [2, 2, 2], atol=0)
+
+    def test_shape_changing_inplace_refused(self, rng):
+        import torch
+
+        from thunder_tpu.interop.torch_frontend import compile_torch_module
+
+        class Bad(torch.nn.Module):
+            def forward(self, x):
+                y = x.clone()
+                y.resize_(2, 6)  # shape change through an in-place method
+                return y
+
+        with pytest.raises(Exception):
+            compile_torch_module(Bad())(torch.randn(3, 4))
+
+
+class TestAliasGroupKeys:
+    def test_aliased_vs_distinct_structures_separate_entries(self, rng):
+        cf = tt.jit(lambda a, b: ltorch.sum(a + b))
+        x = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+        cf(x, x)                    # same object twice -> aliased structure
+        assert cf._cs.cache_misses == 1
+        y = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+        cf(x, y)                    # distinct buffers -> new specialization
+        assert cf._cs.cache_misses == 2
+        cf(y, y)                    # aliased again -> hits the aliased entry
+        assert cf._cs.cache_misses == 2
