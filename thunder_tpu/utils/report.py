@@ -283,3 +283,58 @@ def profile_report(cfn, *args, trace_dir: Optional[str] = None, **kwargs) -> str
         out = cfn(*args, **kwargs)
         jax.block_until_ready(out)
     return trace_dir
+
+
+def profile_summary(fn, *args, steps: int = 3, top: int = 12, trace_dir: Optional[str] = None,
+                    **kwargs) -> dict:
+    """Run ``fn`` under jax.profiler and aggregate device time by op bucket.
+
+    The programmatic form of the analysis behind PROFILE_350M.md (reference
+    report.py's timing tables): buckets pallas kernels by fusion name,
+    groups XLA fusions/copies by kind, and returns ms-per-step numbers —
+    enough to name a bottleneck without opening tensorboard.
+
+    Returns {"buckets": [(name, ms_per_step), ...], "total_ms_per_step",
+    "trace_dir"}. Events overlap (async copies run under compute), so bucket
+    sums can exceed wall clock.
+    """
+    import glob as _glob
+    import re as _re
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    trace_dir = trace_dir or os.path.join("/tmp", f"thunder_tpu_profile_{os.getpid()}")
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # pragma: no cover
+        return {"error": f"xplane parser unavailable: {e}", "trace_dir": trace_dir}
+
+    buckets: dict = {}
+    for path in _glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True):
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(path, "rb").read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name:
+                continue
+            ev_names = {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                for ev in line.events:
+                    nm = ev_names.get(ev.metadata_id, "?")
+                    if nm.startswith("jit_"):  # whole-module envelope event
+                        continue
+                    if "custom-call" in nm and "xla_fusion" in nm:
+                        key = "pallas:" + _re.match(r"%?(xla_fusion_\d+)", nm).group(1)
+                    else:
+                        m = _re.match(r"%?([A-Za-z_]+[A-Za-z_0-9-]*?)(?:[.\d]*) =", nm)
+                        key = m.group(1) if m else nm.split(" ")[0]
+                    buckets[key] = buckets.get(key, 0.0) + ev.duration_ps / 1e9 / steps
+    ranked = sorted(buckets.items(), key=lambda kv: -kv[1])[:top]
+    return {"buckets": [(k, round(v, 3)) for k, v in ranked],
+            "total_ms_per_step": round(sum(buckets.values()), 2),
+            "trace_dir": trace_dir}
