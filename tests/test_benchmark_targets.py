@@ -1,0 +1,42 @@
+"""Benchmark-as-test (reference thunder/benchmarks/targets.py runs as a
+pytest-benchmark suite): every registered target stays importable and the
+cheap ones execute end-to-end on CPU — a registry collision or a target
+whose body rotted (the round-3 dead-duplicate) fails here, not at bench
+time on the chip."""
+import numpy as np
+import pytest
+
+from thunder_tpu.benchmarks import targets
+
+
+def test_registry_nonempty_and_collision_guarded():
+    assert len(targets.BENCHMARKS) >= 20
+    with pytest.raises(ValueError):
+        targets.register("litgpt_gelu")(lambda rng: None)
+
+
+# cheap targets a CPU run can afford (small shapes, fast compiles; the
+# heavier targets run on chip via `python -m thunder_tpu.benchmarks.targets`)
+_CPU_SMOKE = [
+    "litgpt_gelu",
+    "litgpt_rmsnorm",
+]
+
+
+@pytest.mark.parametrize("name", _CPU_SMOKE)
+def test_target_runs(name, rng, monkeypatch):
+    # smoke semantics: one timed iteration, no warmup — CI checks the target
+    # BUILDS and RUNS, the chip run does the real timing
+    real_timeit = targets._timeit
+    monkeypatch.setattr(targets, "_timeit",
+                        lambda fn, *a, **kw: real_timeit(fn, *a, iters=1, warmup=0))
+    seconds = targets.BENCHMARKS[name](np.random.RandomState(0))
+    assert seconds is None or (isinstance(seconds, float) and seconds > 0)
+
+
+def test_all_targets_are_callables_with_rng_arg():
+    import inspect
+
+    for name, fn in targets.BENCHMARKS.items():
+        sig = inspect.signature(fn)
+        assert len(sig.parameters) == 1, f"{name} must take (rng)"

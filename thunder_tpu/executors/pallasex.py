@@ -199,6 +199,50 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
+def _flash_bwd_dkv_kernel_mha(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                          block_q: int, causal: bool, scale: float):
+    block_k, D = k_ref.shape
+    T = q_ref.shape[0]
+    ki = pl.program_id(2)
+    k_blk = k_ref[:]
+    v_blk = v_ref[:]
+    # work in the TRANSPOSED orientation (rows = k positions): every dot then
+    # contracts lhs dim 1 against rhs dim 0/1 naturally — the straight
+    # orientation needs pᵀ/dsᵀ for dv/dk, and those in-kernel transposes of
+    # (block_q, block_k) tiles cost more than the matmuls themselves
+    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
+        if causal:
+            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+        p_t = jnp.exp2(s_t - lse2[None, :])
+        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                              (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)  # (bk, bq)
+        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    i0 = (ki * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(i0, T // block_q, body, (z, z))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+
+
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                           dk_scr, dv_scr, *, causal: bool, scale: float, g: int, n_i: int):
     # GQA-aware, VMEM-bounded: grid (B, Hkv, T//block_k, T//block_q) streams
@@ -298,7 +342,33 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
 
-    # q heads grouped per kv head: view q/do/lse/delta as (B, Hkv, g, T, ...)
+    if g == 1:
+        # MHA fast path: full-T q/do resident per program (measured faster
+        # than the streaming grid at llama-350m shapes)
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel_mha, block_q=block_q, causal=causal, scale=scale),
+            grid=(B, H, Tk // block_k),
+            in_specs=[
+                pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
+                jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+            ],
+            interpret=_interpret(),
+        )(q, k, v, do, lse4, delta4)
+        return dq, dk, dv
+
+    # GQA: q heads grouped per kv head — view q/do/lse/delta as (B, Hkv, g, T, ...)
     qg = q.reshape(B, Hkv, g, T, D)
     dog = do.reshape(B, Hkv, g, T, D)
     lseg = lse4.reshape(B, Hkv, g, T, 1)
@@ -486,6 +556,49 @@ def _flash_rope_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[:] = _rope_vjp_block(dq_r, cq_ref[:], sq_ref[:]).astype(dq_ref.dtype)
 
 
+def _flash_rope_bwd_dkv_kernel_mha(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                               cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref, *,
+                               block_q: int, causal: bool, scale: float):
+    block_k, D = k_ref.shape
+    T = q_ref.shape[0]
+    ki = pl.program_id(2)
+    k_blk = _rope_block(k_ref[:].astype(jnp.float32), ck_ref[:], sk_ref[:]).astype(k_ref.dtype)
+    v_blk = v_ref[:]
+    k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = _rope_block(q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32),
+                        cq_ref[pl.ds(i * block_q, block_q), :],
+                        sq_ref[pl.ds(i * block_q, block_q), :]).astype(q_ref.dtype)
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32) * (scale * LOG2E)
+        if causal:
+            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+        p_t = jnp.exp2(s_t - lse2[None, :])
+        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                              (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    i0 = (ki * block_k) // block_q if causal else 0
+    dk_r, dv = jax.lax.fori_loop(i0, T // block_q, body, (z, z))
+    dk_ref[:] = _rope_vjp_block(dk_r, ck_ref[:], sk_ref[:]).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+
+
 def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref,
                                dk_scr, dv_scr, *, causal: bool, scale: float,
@@ -587,6 +700,35 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+
+    if g == 1:
+        # MHA fast path (see flash_attention_backward)
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_rope_bwd_dkv_kernel_mha, block_q=block_q, causal=causal, scale=scale),
+            grid=(B, H, T // block_k),
+            in_specs=[
+                pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, T, D), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j: (b, h, 0, 0)),
+                pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
+                pl.BlockSpec((T, D), lambda b, h, j: (0, 0)),
+                pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
+                pl.BlockSpec((block_k, D), lambda b, h, j: (j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j: (b, h, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, T, D), k.dtype),
+                jax.ShapeDtypeStruct((B, H, T, D), v.dtype),
+            ],
+            interpret=_interpret(),
+        )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+        return dq, dk, dv
 
     qg = q.reshape(B, Hkv, g, T, D)
     dog = do.reshape(B, Hkv, g, T, D)
