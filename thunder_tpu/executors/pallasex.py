@@ -199,6 +199,27 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
+def _dkv_tile(k_blk, v_blk, q, do, lse2, delta, k_pos_t, q_pos_t, causal,
+              scale, dk_acc, dv_acc):
+    """One (k-block x q-tile) contribution to dk/dv, transposed orientation
+    (rows = k positions) in log2 units — the single source of truth for all
+    four dkv kernels (MHA/GQA x plain/rope)."""
+    s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
+    if causal:
+        s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+    p_t = jnp.exp2(s_t - lse2[None, :])
+    dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
+                                          (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+    dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # (bk, bq)
+    ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+    dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+    return dk_acc, dv_acc
+
+
 def _flash_bwd_dkv_kernel_mha(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
                           block_q: int, causal: bool, scale: float):
     block_k, D = k_ref.shape
@@ -213,26 +234,13 @@ def _flash_bwd_dkv_kernel_mha(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, d
     k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
 
     def body(i, carry):
-        dk_acc, dv_acc = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :]
         lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
         delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
-        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
-        if causal:
-            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
-            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp2(s_t - lse2[None, :])
-        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                              (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=jnp.float32)  # (bk, bq)
-        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+        q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        return _dkv_tile(k_blk, v_blk, q, do, lse2, delta, k_pos_t, q_pos_t,
+                         causal, scale, *carry)
 
     z = jnp.zeros((block_k, D), jnp.float32)
     i0 = (ki * block_k) // block_q if causal else 0
@@ -276,23 +284,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         dk_acc = dk_scr[:]
         dv_acc = dv_scr[:]
         for h in range(g):  # static unroll over the q-head group
-            q = q_ref[h]
-            do = do_ref[h]
-            lse2 = lse_ref[h][:, 0] * LOG2E
-            delta = delta_ref[h][:, 0]
-            s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32) * (scale * LOG2E)  # (bk, bq)
-            if causal:
-                s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-            p_t = jnp.exp2(s_t - lse2[None, :])
-            dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                                  (((1,), (0,)), ((), ())),
-                                                  preferred_element_type=jnp.float32)
-            dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                       preferred_element_type=jnp.float32)  # (bk, bq)
-            ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-            dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                                  preferred_element_type=jnp.float32)
+            dk_acc, dv_acc = _dkv_tile(
+                k_blk, v_blk, q_ref[h], do_ref[h], lse_ref[h][:, 0] * LOG2E,
+                delta_ref[h][:, 0], k_pos_t, q_pos_t, causal, scale,
+                dk_acc, dv_acc)
         dk_scr[:] = dk_acc
         dv_scr[:] = dv_acc
 
@@ -567,28 +562,15 @@ def _flash_rope_bwd_dkv_kernel_mha(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_r
     k_pos_t = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
 
     def body(i, carry):
-        dk_acc, dv_acc = carry
         q = _rope_block(q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32),
                         cq_ref[pl.ds(i * block_q, block_q), :],
                         sq_ref[pl.ds(i * block_q, block_q), :]).astype(q_ref.dtype)
         do = do_ref[pl.ds(i * block_q, block_q), :]
         lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
         delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
-        s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32) * (scale * LOG2E)
-        if causal:
-            q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
-            s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-        p_t = jnp.exp2(s_t - lse2[None, :])
-        dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                              (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
-        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                              preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+        q_pos_t = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+        return _dkv_tile(k_blk, v_blk, q, do, lse2, delta, k_pos_t, q_pos_t,
+                         causal, scale, *carry)
 
     z = jnp.zeros((block_k, D), jnp.float32)
     i0 = (ki * block_k) // block_q if causal else 0
@@ -629,22 +611,10 @@ def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         for h in range(g):  # static unroll over the q-head group
             q = _rope_block(q_ref[h].astype(jnp.float32),
                             cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
-            do = do_ref[h]
-            lse2 = lse_ref[h][:, 0] * LOG2E
-            delta = delta_ref[h][:, 0]
-            s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32) * (scale * LOG2E)
-            if causal:
-                s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
-            p_t = jnp.exp2(s_t - lse2[None, :])
-            dv_acc = dv_acc + jax.lax.dot_general(p_t.astype(do.dtype), do,
-                                                  (((1,), (0,)), ((), ())),
-                                                  preferred_element_type=jnp.float32)
-            dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
-                                       preferred_element_type=jnp.float32)
-            ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
-            dk_acc = dk_acc + jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
-                                                  preferred_element_type=jnp.float32)
+            dk_acc, dv_acc = _dkv_tile(
+                k_blk, v_blk, q, do_ref[h], lse_ref[h][:, 0] * LOG2E,
+                delta_ref[h][:, 0], k_pos_t, q_pos_t, causal, scale,
+                dk_acc, dv_acc)
         dk_scr[:] = dk_acc
         dv_scr[:] = dv_acc
 
