@@ -10,6 +10,11 @@ latency-hiding scheduler (the role NCCL + wait-sorting play in the
 reference, thunder/distributed/__init__.py).
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import os
 
 import jax
 

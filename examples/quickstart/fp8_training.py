@@ -8,6 +8,11 @@ buffers riding the one compiled step program). Loss tracks bf16 within
 tolerance; on fp8-native TPU generations the MXU runs the quantized
 matmuls directly.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
 import jax.numpy as jnp
 import numpy as np
 

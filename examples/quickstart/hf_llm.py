@@ -9,6 +9,11 @@ compiles it with XLA. Generation uses the KV-cached engine whose whole
 greedy decode loop is ONE XLA dispatch (the role CUDA graphs play in the
 reference's hf_llm.py quickstart).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
 import time
 
 import jax.numpy as jnp

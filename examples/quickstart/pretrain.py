@@ -10,6 +10,11 @@ MXU's native dtype while masters stay fp32.
 (Counterpart of the reference's LitGPT pretraining entry,
 thunder/benchmarks/benchmark_litgpt.py.)
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
 import argparse
 import time
 

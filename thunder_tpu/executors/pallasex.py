@@ -40,6 +40,9 @@ register_executor(ex)
 # k-major blocks keep the MXU fed during the online-softmax accumulation
 DEFAULT_BLOCK_Q = int(os.environ.get("TT_FLASH_BLOCK_Q", "512"))
 DEFAULT_BLOCK_K = int(os.environ.get("TT_FLASH_BLOCK_K", "1024"))
+# k-block cap for the GQA streaming dkv backward (swept separately: its
+# working set scales with block_k x block_q tiles plus the group's q/do)
+_GQA_BLOCK_K = int(os.environ.get("TT_FLASH_GQA_BLOCK_K", "512"))
 
 
 def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int, *extra):
@@ -313,10 +316,10 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     block_k = min(block_k, Tk)
     block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk, k, v, do)
     if g > 1:
-        # grouped-kv double buffering vmem guard; gcd keeps divisibility
-        # under TT_FLASH_BLOCK_* overrides (a non-divisor block would
-        # silently truncate the dkv grid)
-        block_k = math.gcd(min(block_k, 512), Tk)
+        # grouped-kv vmem guard for the streaming dkv grid; gcd keeps
+        # divisibility under overrides (a non-divisor block would silently
+        # truncate the dkv grid). TT_FLASH_GQA_BLOCK_K tunes it.
+        block_k = math.gcd(min(block_k, _GQA_BLOCK_K), Tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (B,H,T)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
@@ -640,11 +643,8 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
     block_k = min(block_k, T)
     block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, T, k, v, do)
     if g > 1:
-        # grouped kv blocks are revisited across q-head programs; Mosaic's
-        # double-buffering pushes the 1024-row block ~160K over the 16M
-        # scoped-vmem limit — halve the k block for GQA (gcd: stay a divisor
-        # of T under TT_FLASH_BLOCK_* overrides)
-        block_k = math.gcd(min(block_k, 512), T)
+        # grouped-kv vmem guard (see flash_attention_backward)
+        block_k = math.gcd(min(block_k, _GQA_BLOCK_K), T)
     cos = cos.astype(jnp.float32)
     sin = sin.astype(jnp.float32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
