@@ -1628,3 +1628,86 @@ ERROR_OPINFOS += [
     ("roll", ltorch.roll, _err_roll),
     ("fold", ltorch.fold, _err_fold),
 ]
+
+
+# --- error-input wave 3 (round 4: the newly covered surface) ---------------
+
+
+def _err_index_add(rng):
+    yield (_t(rng, 5, 4), 7, jnp.asarray([0, 1], jnp.int32), _t(rng, 2, 4)), {}, IndexError, "dim|range"
+
+
+def _err_scatter_add(rng):
+    yield (_t(rng, 4, 10), 9, jnp.zeros((4, 3), jnp.int32), _t(rng, 4, 3)), {}, IndexError, "dim|range"
+
+
+def _err_conv1d(rng):
+    yield (_t(rng, 2, 3, 10), _t(rng, 4, 5, 3)), {}, RuntimeError, "channel"
+
+
+def _err_vector_norm(rng):
+    yield (_t(rng, 3, 4),), {"ord": "bad"}, RuntimeError, "ord|norm|p "
+
+
+def _err_hsplit(rng):
+    yield (_t(rng, 3, 7), 2), {}, RuntimeError, "divis|split|section"
+
+
+def _err_movedim(rng):
+    yield (_t(rng, 2, 3, 4), 0, 5), {}, IndexError, "dim|range"
+
+
+def _err_prod(rng):
+    yield (_t(rng, 2, 3),), {"dim": 4}, IndexError, "dim|range"
+
+
+def _err_lerp(rng):
+    yield (_t(rng, 3, 4), _t(rng, 2, 5), 0.3), {}, RuntimeError, "broadcast|shape"
+
+
+def _err_atleast(rng):
+    # atleast_2d over a bad argument type must raise loudly, not silently wrap
+    yield ("not a tensor",), {}, Exception, ""
+
+
+def _err_std(rng):
+    yield (_t(rng, 2, 3),), {"dim": 5}, IndexError, "dim|range"
+
+
+def _err_tensor_split(rng):
+    yield (_t(rng, 2, 6), 3, 4), {}, IndexError, "dim|range"
+
+
+def _err_swiglu(rng):
+    yield (_t(rng, 3, 8), _t(rng, 3, 6)), {}, RuntimeError, "broadcast|shape"
+
+
+def _err_addbmm(rng):
+    yield (_t(rng, 3, 5), _t(rng, 2, 3, 4), _t(rng, 2, 5, 5)), {}, RuntimeError, "matmul|shape|contract"
+
+
+def _err_multi_dot(rng):
+    yield ([_t(rng, 3, 4), _t(rng, 5, 6)],), {}, RuntimeError, "matmul|shape|contract"
+
+
+def _err_pixel_unshuffle(rng):
+    yield (_t(rng, 1, 2, 5, 6), 2), {}, RuntimeError, "divis|factor|shuffle"
+
+
+ERROR_OPINFOS += [
+    ("index_add_dim", lambda a, d, i, s: ltorch.index_add(a, d, i, s), _err_index_add),
+    ("scatter_add_dim", lambda a, d, i, s: ltorch.scatter_add(a, d, i, s), _err_scatter_add),
+    ("conv1d_channels", ltorch.conv1d, _err_conv1d),
+    ("vector_norm_ord", ltorch.vector_norm, _err_vector_norm),
+    ("hsplit_indivisible", ltorch.hsplit, _err_hsplit),
+    ("movedim", ltorch.movedim, _err_movedim),
+    ("prod_dim", ltorch.prod, _err_prod),
+    ("lerp_shape", ltorch.lerp, _err_lerp),
+    ("atleast_2d_badarg", ltorch.atleast_2d, _err_atleast),
+    ("std_dim", ltorch.std, _err_std),
+    ("tensor_split_dim", ltorch.tensor_split, _err_tensor_split),
+    ("swiglu_shape", ltorch.swiglu, _err_swiglu),
+    ("addbmm_shape", ltorch.addbmm, _err_addbmm),
+    ("multi_dot_shape", ltorch.multi_dot, _err_multi_dot),
+    ("pixel_unshuffle_factor", ltorch.pixel_unshuffle, _err_pixel_unshuffle),
+]

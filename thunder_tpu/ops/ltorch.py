@@ -2295,6 +2295,10 @@ def pixel_shuffle(a, upscale_factor):
 def pixel_unshuffle(a, downscale_factor):
     r = pyval(downscale_factor)
     N, C, H, W = a.shape
+    if H % r != 0 or W % r != 0:
+        raise RuntimeError(
+            f"pixel_unshuffle: spatial dims ({H}, {W}) must be divisible by "
+            f"downscale_factor {r}")
     out = clang.reshape(a, (N, C, H // r, r, W // r, r))
     out = clang.permute(out, (0, 1, 3, 5, 2, 4))
     return clang.reshape(out, (N, C * r * r, H // r, W // r))
