@@ -144,3 +144,77 @@ def test_quantized_bias_trains(rng):
         step(x, y)
     b_after = np.asarray(net.body.fc2._parameters["bias"].data)
     assert np.abs(b_after - b_before).max() > 1e-5, "bias froze under quantization"
+
+
+class TestFusedInt8Linear:
+    """The Pallas dequant-in-kernel linear (executors/pallasex.py int8_linear):
+    weights stay int8-resident in HBM — XLA's separate-dequant path hoists the
+    dequant out of loops and materializes bf16 weights, defeating weight-only
+    quantization's memory saving."""
+
+    def test_kernel_matches_dequant_reference(self, rng):
+        import jax.numpy as jnp
+
+        from thunder_tpu.executors import pallasex as px
+
+        x = jnp.asarray(rng.randn(8, 512).astype(np.float32), jnp.bfloat16)
+        w = jnp.asarray(np.clip(np.round(rng.randn(256, 512) * 40), -127, 127), jnp.int8)
+        s = jnp.asarray(np.abs(rng.randn(256)) * 1e-3 + 1e-4, jnp.float32)
+        got = np.asarray(px.int8_linear(x, w, s), np.float32)
+        want = np.asarray(x, np.float32) @ (np.asarray(w, np.float32) * np.asarray(s)[:, None]).T
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+    def test_pallas_claims_quantized_linear(self, rng):
+        import jax.numpy as jnp
+
+        import thunder_tpu as tt
+        from thunder_tpu import nn
+        from thunder_tpu.executors import pallasex as px
+        from thunder_tpu.transforms.quantization import QuantizeInt8Transform
+
+        calls = {"n": 0}
+        orig = px._int8_linear_impl
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        px.ex.register_implementation("quant.linear_int8", spy,
+                                      checker=px._int8_linear_supported)
+        try:
+            class Net(nn.Module):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = nn.Linear(512, 256, seed=1)
+
+                def forward(self, x):
+                    return self.fc(x)
+
+            net = Net()
+            ref_w = np.asarray(net.fc.weight.data)
+            tm = tt.jit(net, transforms=[QuantizeInt8Transform()])
+            x = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+            out = np.asarray(tm(x), np.float32)
+            assert calls["n"] >= 1, "pallas did not claim quant.linear_int8"
+            want = np.asarray(x) @ ref_w.T
+            np.testing.assert_allclose(out, want, atol=0.05, rtol=0.05)
+        finally:
+            px.ex.register_implementation("quant.linear_int8", orig,
+                                          checker=px._int8_linear_supported)
+
+    def test_checker_declines_large_m_and_odd_shapes(self, rng):
+        from thunder_tpu.core.proxies import TensorProxy
+        from thunder_tpu.core import dtypes as dt
+        from thunder_tpu.executors import pallasex as px
+
+        def p(shape, dtype=dt.bfloat16):
+            return TensorProxy(shape=shape, dtype=dtype, device=None)
+
+        ok = px._int8_linear_supported(p((8, 512)), p((256, 512), dt.int8), p((256,), dt.float32))
+        assert ok
+        # prefill-size M stays on the XLA path
+        assert not px._int8_linear_supported(p((4096, 512)), p((256, 512), dt.int8), p((256,), dt.float32))
+        # non-128-multiple N declines
+        assert not px._int8_linear_supported(p((8, 512)), p((250, 512), dt.int8), p((250,), dt.float32))
+        # non-int8 weights decline
+        assert not px._int8_linear_supported(p((8, 512)), p((256, 512)), p((256,), dt.float32))
