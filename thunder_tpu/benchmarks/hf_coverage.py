@@ -182,8 +182,18 @@ def main(argv=None):
             f"| {r['model']} | {r['status']} | "
             f"{r['max_abs_err'] if r['max_abs_err'] is not None else '—'} | "
             f"{r['bwd_max_rel_err'] if r['bwd_max_rel_err'] is not None else '—'} | {fb} |")
+    # regenerate the table but carry over hand-measured sections appended
+    # after it (e.g. the timed KV-cache generation artifact)
+    extra = ""
+    try:
+        prev = open(args.out).read()
+        cut = prev.find("\n## ")
+        if cut != -1:
+            extra = prev[cut:]
+    except OSError:
+        pass
     with open(args.out, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write("\n".join(lines) + "\n" + extra)
     ok = sum(1 for r in rows if r["status"] == "ok")
     print(f"# {ok}/{len(rows)} architectures ok -> {args.out}")
     return rows
