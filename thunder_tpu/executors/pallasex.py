@@ -1139,3 +1139,110 @@ def _int8_linear_impl(x, qweight, scale, bias=None):
 
 ex.register_implementation("quant.linear_int8", _int8_linear_impl,
                            checker=_int8_linear_supported)
+
+
+# ===========================================================================
+# Fused NF4 dequant-matmul (4-bit weight-only linear, opt-in serving kernel)
+# ===========================================================================
+#
+# Weights stay PACKED (0.5 byte/element) in HBM; the kernel unpacks nibbles,
+# looks the 16-entry NF4 codebook up via a select tree (Mosaic has no
+# small-table gather), applies per-64-block absmax via a 0/1 expander dot,
+# and feeds the MXU. Measured at a decode GEMM (M=8, K=4096, N=11008):
+# ~0.95x the bf16-weight matmul speed at 4x smaller weight footprint — the
+# bitsandbytes trade (footprint over speed), TPU-native. Opt-in via
+# nf4_linear + pack_nf4_kernel_layout; the canonical QuantizeNF4Transform
+# path keeps its XLA dequant (which XLA may hoist/materialize).
+#
+# Kernel packing layout: within each block_k slice of a row, byte j holds
+# the codes of columns j (hi nibble) and j + block_k/2 (lo nibble) — dequant
+# is then a contiguous concat, avoiding Mosaic-unsupported lane interleaves.
+
+NF4_KERNEL_BLOCK_K = 512
+
+
+def pack_nf4_kernel_layout(packed, absmax, shape, block_size: int = 64):
+    """Canonical NF4 (flat hi/lo interleave) -> kernel layout
+    ((N, K/2) uint8 halves-per-slice + (N, K/block_size) absmax)."""
+    N, K = shape
+    bk = min(NF4_KERNEL_BLOCK_K, K)
+    hi = (packed >> 4) & 0xF
+    lo = packed & 0xF
+    codes = jnp.stack([hi, lo], axis=1).reshape(N, K)
+    parts = []
+    for j0 in range(0, K, bk):
+        sl = codes[:, j0:j0 + bk]
+        parts.append((sl[:, : bk // 2] << 4) | sl[:, bk // 2:])
+    return jnp.concatenate(parts, axis=1).astype(jnp.uint8), absmax.reshape(N, K // block_size)
+
+
+def _nf4_codebook_floats():
+    # python-float codebook, resolved OUTSIDE kernel tracing (pallas kernels
+    # can neither capture array constants nor concretize values mid-trace)
+    import numpy as _np
+
+    from ..transforms.quantization import NF4_CODE
+
+    return [float(v) for v in _np.asarray(NF4_CODE)]
+
+
+def _nf4_lookup(codes, vals):
+    """16-way select tree over the NF4 codebook (Mosaic has no small-table
+    gather)."""
+    out = jnp.full(codes.shape, vals[0], jnp.float32)
+    for idx in range(1, 16):
+        out = jnp.where(codes == idx, vals[idx], out)
+    return out
+
+
+def _nf4_linear_kernel(x_ref, p_ref, a_ref, o_ref, *, block_k: int, block_size: int,
+                       codebook: tuple):
+    M, K = x_ref.shape
+    bn = p_ref.shape[0]
+    acc = jnp.zeros((M, bn), jnp.float32)
+    for j in range(K // block_k):  # static unroll: lane offsets stay provable
+        xs = x_ref[:, j * block_k:(j + 1) * block_k]
+        byts = p_ref[:, j * (block_k // 2):(j + 1) * (block_k // 2)]
+        b32 = byts.astype(jnp.int32)  # minor-dim ops need 32-bit types
+        hi = (b32 >> 4) & 0xF
+        lo = b32 & 0xF
+        w = jnp.concatenate([_nf4_lookup(hi, codebook), _nf4_lookup(lo, codebook)], axis=-1)
+        nb = block_k // block_size
+        am = a_ref[:, j * nb:(j + 1) * nb]
+        # repeat-along-lanes via a 0/1 expander dot (jnp.repeat's reshape is
+        # an unsupported Mosaic shape cast)
+        row = jax.lax.broadcasted_iota(jnp.int32, (nb, block_k), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (nb, block_k), 1) // block_size
+        expander = (row == col).astype(jnp.float32)
+        am_full = jax.lax.dot_general(am, expander, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        ws = (w * am_full).astype(xs.dtype)
+        acc = acc + jax.lax.dot_general(xs, ws, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def nf4_linear(x, packed_kl, absmax_kl, *, block_n: int = 256, block_size: int = 64):
+    """x (..., K) against kernel-layout NF4 weights (see
+    pack_nf4_kernel_layout) -> (..., N)."""
+    shape = x.shape
+    K = shape[-1]
+    N = packed_kl.shape[0]
+    x2d = x.reshape((-1, K))
+    M = x2d.shape[0]
+    block_n = math.gcd(block_n, N)
+    block_k = min(NF4_KERNEL_BLOCK_K, K)
+    out = pl.pallas_call(
+        functools.partial(_nf4_linear_kernel, block_k=block_k, block_size=block_size,
+                          codebook=tuple(_nf4_codebook_floats())),
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda n: (0, 0)),
+            pl.BlockSpec((block_n, K // 2), lambda n: (n, 0)),
+            pl.BlockSpec((block_n, K // block_size), lambda n: (n, 0)),
+        ],
+        out_specs=pl.BlockSpec((M, block_n), lambda n: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        interpret=_interpret(),
+    )(x2d, packed_kl, absmax_kl.astype(jnp.float32))
+    return out.reshape(shape[:-1] + (N,))
