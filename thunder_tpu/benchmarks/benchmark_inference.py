@@ -33,6 +33,16 @@ def run(args) -> dict:
         gpt = MoEGPT(cfg, moe_cfg, dtype=dtype)
     else:
         gpt = GPT(cfg, dtype=dtype)
+    if args.quantize == "int8":
+        # weight-only int8: decode-shape linears claim the fused
+        # dequant-in-kernel Pallas matmul (weights stay int8 in HBM)
+        from thunder_tpu.transforms.quantization import QuantizeInt8Transform
+
+        QuantizeInt8Transform().transform_module(gpt)
+    elif args.quantize == "nf4":
+        from thunder_tpu.transforms.quantization import QuantizeNF4Transform
+
+        QuantizeNF4Transform().transform_module(gpt)
     engine = GPTInference(gpt, dtype=dtype)
 
     rng = np.random.RandomState(0)
@@ -43,7 +53,8 @@ def run(args) -> dict:
     out, m = engine.generate(prompt, max_new_tokens=args.max_new_tokens, temperature=args.temperature)
 
     result = {
-        "model": args.model_name + ("+moe" if args.moe else ""),
+        "model": args.model_name + ("+moe" if args.moe else "")
+                 + (f"+{args.quantize}" if args.quantize else ""),
         "batch_size": args.batch_size,
         "prompt_len": args.prompt_len,
         "new_tokens": m.n_new_tokens,
@@ -58,6 +69,8 @@ def run(args) -> dict:
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--quantize", choices=["int8", "nf4"], default=None,
+                   help="weight-only quantization before compiling the engine")
     p.add_argument("--model_name", default="tiny-llama2")
     p.add_argument("--batch_size", type=int, default=1)
     p.add_argument("--prompt_len", type=int, default=64)
