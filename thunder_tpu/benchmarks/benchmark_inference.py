@@ -48,8 +48,10 @@ def run(args) -> dict:
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch_size, args.prompt_len)))
 
-    # warmup (compile prefill + decode)
-    engine.generate(prompt, max_new_tokens=4)
+    # warmup at the MEASURED step count: the scan-decode program is keyed on
+    # (n_steps, batch, dtype) — warming with a different count would leave
+    # the timed run paying the scan compile
+    engine.generate(prompt, max_new_tokens=args.max_new_tokens)
     out, m = engine.generate(prompt, max_new_tokens=args.max_new_tokens, temperature=args.temperature)
 
     result = {
