@@ -1161,11 +1161,24 @@ ex.register_implementation("quant.linear_int8", _int8_linear_impl,
 NF4_KERNEL_BLOCK_K = 512
 
 
+def nf4_kernel_block_k(K: int, block_size: int = 64):
+    """Largest K-slice width the kernel layout supports for this K: a
+    divisor of K, multiple of 2*block_size (nibble halves stay block-aligned)
+    and of 256 (the (K/2) lane offsets stay 128-aligned), capped at 512.
+    None when no such width exists (e.g. K=2816 -> 256; K=1000 -> None)."""
+    for bk in (512, 384, 256, 128):
+        if bk <= K and K % bk == 0 and bk % (2 * block_size) == 0 and (bk // 2) % 128 == 0:
+            return bk
+    return None
+
+
 def pack_nf4_kernel_layout(packed, absmax, shape, block_size: int = 64):
     """Canonical NF4 (flat hi/lo interleave) -> kernel layout
     ((N, K/2) uint8 halves-per-slice + (N, K/block_size) absmax)."""
     N, K = shape
-    bk = min(NF4_KERNEL_BLOCK_K, K)
+    bk = nf4_kernel_block_k(K, block_size)
+    if bk is None:
+        raise ValueError(f"no kernel block width for K={K} (see nf4_kernel_block_k)")
     hi = (packed >> 4) & 0xF
     lo = packed & 0xF
     codes = jnp.stack([hi, lo], axis=1).reshape(N, K)
@@ -1231,7 +1244,7 @@ def nf4_linear(x, packed_kl, absmax_kl, *, block_n: int = 256, block_size: int =
     x2d = x.reshape((-1, K))
     M = x2d.shape[0]
     block_n = math.gcd(block_n, N)
-    block_k = min(NF4_KERNEL_BLOCK_K, K)
+    block_k = nf4_kernel_block_k(K, block_size)
     out = pl.pallas_call(
         functools.partial(_nf4_linear_kernel, block_k=block_k, block_size=block_size,
                           codebook=tuple(_nf4_codebook_floats())),
@@ -1246,3 +1259,32 @@ def nf4_linear(x, packed_kl, absmax_kl, *, block_n: int = 256, block_size: int =
         interpret=_interpret(),
     )(x2d, packed_kl, absmax_kl.astype(jnp.float32))
     return out.reshape(shape[:-1] + (N,))
+
+
+def _nf4_kl_supported(x, packed_kl, absmax_kl, out_features, in_features,
+                      block_size=64, bias=None):
+    try:
+        N, K, bs = int(out_features), int(in_features), int(block_size)
+    except Exception:
+        return False
+    M = 1
+    for d in getattr(x, "shape", ())[:-1]:
+        M *= int(d)
+    return (
+        getattr(x, "ndim", 0) >= 2 and x.shape[-1] == K
+        and bs == 64 and nf4_kernel_block_k(K, bs) is not None
+        and N % 128 == 0
+        and M <= 512
+    )
+
+
+def _nf4_kl_impl(x, packed_kl, absmax_kl, out_features, in_features,
+                 block_size=64, bias=None):
+    out = nf4_linear(x, packed_kl, absmax_kl, block_size=int(block_size))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+ex.register_implementation("quant.linear_nf4_kl", _nf4_kl_impl,
+                           checker=_nf4_kl_supported)

@@ -221,6 +221,30 @@ class QuantizeNF4Transform(Transform):
             if in_f % self.block_size:
                 continue  # non-divisible layers stay full precision
             packed, absmax = quantize_nf4(w, self.block_size)
+            from ..executors.pallasex import nf4_kernel_block_k
+
+            kernel_ok = (
+                self.block_size == 64 and out_f % 128 == 0
+                and nf4_kernel_block_k(in_f, self.block_size) is not None
+            )
+            if kernel_ok:
+                # store the fused kernel's halves-per-slice layout: decode
+                # steps read 4-bit weights directly, no per-step repack
+                from ..executors.pallasex import pack_nf4_kernel_layout
+
+                pkl, akl = pack_nf4_kernel_layout(packed, absmax, (out_f, in_f), self.block_size)
+                mod._parameters["weight"] = Parameter(pkl, requires_grad=False)
+                mod.register_parameter("absmax", Parameter(akl, requires_grad=False))
+
+                def make_fwd_kl(m, o, i, bs):
+                    def forward(x):
+                        return nf4_linear_kl(x, m._parameters["weight"], m._parameters["absmax"],
+                                             o, i, bs, m._parameters.get("bias"))
+
+                    return forward
+
+                mod.forward = make_fwd_kl(mod, out_f, in_f, self.block_size)
+                continue
             mod._parameters["weight"] = Parameter(packed, requires_grad=False)
             mod.register_parameter("absmax", Parameter(absmax, requires_grad=False))
 
@@ -232,3 +256,92 @@ class QuantizeNF4Transform(Transform):
                 return forward
 
             mod.forward = make_fwd(mod, out_f, in_f, self.block_size)
+
+
+# ---------------------------------------------------------------------------
+# kernel-layout NF4 linear: weights stored in the fused Pallas kernel's
+# halves-per-slice packing at TRANSFORM time, so decode steps never repack
+# (repack ops inside a lax.scan body are not reliably hoisted by XLA)
+# ---------------------------------------------------------------------------
+
+NF4_KL_BLOCK_K = 512
+
+
+def dequantize_nf4_kl(packed_kl, absmax_kl, shape, block_size: int = 64,
+                      block_k=None):
+    """Kernel-layout NF4 -> full weights (the jax fallback/dequant path:
+    within each block_k slice of a row, hi nibbles cover the first half)."""
+    from ..executors.pallasex import nf4_kernel_block_k
+
+    N, K = shape
+    bk = block_k or nf4_kernel_block_k(K, block_size)
+    parts = []
+    for j0 in range(0, K, bk):
+        byts = packed_kl[:, j0 // 2:(j0 + bk) // 2].astype(jnp.int32)
+        hi = (byts >> 4) & 0xF
+        lo = byts & 0xF
+        parts.append(jnp.concatenate([NF4_CODE[hi], NF4_CODE[lo]], axis=-1))
+    w = jnp.concatenate(parts, axis=1)
+    am = jnp.repeat(absmax_kl.reshape(N, K // block_size), block_size, axis=1)
+    return w * am
+
+
+def _nf4_linear_kl_meta(x, packed_kl, absmax_kl, out_features, in_features,
+                        block_size=64, bias=None):
+    from ..core.proxies import pyval
+
+    return TensorProxy(shape=x.shape[:-1] + (int(pyval(out_features)),), dtype=x.dtype,
+                       device=x.device)
+
+
+def _nf4_linear_kl_impl(x, packed_kl, absmax_kl, out_features, in_features,
+                        block_size=64, bias=None):
+    w = dequantize_nf4_kl(packed_kl, absmax_kl, (out_features, in_features),
+                          block_size).astype(jnp.bfloat16)
+    out = jnp.matmul(x, w.T.astype(x.dtype), preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+nf4_linear_kl = Symbol(
+    "nf4_linear_kl", _nf4_linear_kl_meta, id="quant.linear_nf4_kl", is_prim=True,
+    module="quant", tags=(OpTags.MATMUL_OP,),
+)
+jax_ex.register_implementation(nf4_linear_kl.id, _nf4_linear_kl_impl)
+
+
+@register_augmented_forward(nf4_linear_kl.id)
+def _nf4_kl_aug(x, packed_kl, absmax_kl, out_features, in_features, block_size=64, bias=None):
+    return VJPResult(
+        nf4_linear_kl(x, packed_kl, absmax_kl, out_features, in_features, block_size, bias),
+        (packed_kl, absmax_kl, out_features, in_features, block_size, bias is not None))
+
+
+@register_backward(nf4_linear_kl.id)
+def _nf4_kl_bwd(packed_kl, absmax_kl, out_features, in_features, block_size, has_bias, g):
+    from ..core import prims
+
+    w = dequant_nf4_kl_sym(packed_kl, absmax_kl, out_features, in_features, block_size)
+    wb = prims.convert_element_type(w, dtypes.bfloat16)
+    gx = prims.matmul(prims.convert_element_type(g, dtypes.bfloat16), wb)
+    gx = prims.convert_element_type(gx, g.dtype)
+    if has_bias:
+        gbias = prims.sum_prim(g, tuple(range(g.ndim - 1))) if g.ndim > 1 else g
+        return gx, None, None, None, None, None, gbias
+    return gx, None, None, None, None, None
+
+
+def _dequant_nf4_kl_meta(packed_kl, absmax_kl, out_features, in_features, block_size=64):
+    from ..core.proxies import pyval
+
+    return TensorProxy(shape=(int(pyval(out_features)), int(pyval(in_features))),
+                       dtype=dtypes.float32, device=packed_kl.device)
+
+
+dequant_nf4_kl_sym = Symbol("nf4_dequant_kl", _dequant_nf4_kl_meta,
+                            id="quant.nf4_dequant_kl", is_prim=True, module="quant")
+jax_ex.register_implementation(
+    dequant_nf4_kl_sym.id,
+    lambda packed_kl, absmax_kl, o, i, block_size=64: dequantize_nf4_kl(
+        packed_kl, absmax_kl, (o, i), block_size))
