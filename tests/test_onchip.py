@@ -131,3 +131,34 @@ def test_gqa_rope_flash_train_step_on_chip():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
     srcs = [t.python() for t in step._vag._cs.last_traces]
     assert any("rope_flash_fwd" in s for s in srcs)
+
+
+def test_fused_quantized_linears_on_chip():
+    """int8 and NF4 dequant-in-kernel matmuls vs their dequant references on
+    the real chip (Mosaic lowering differs from interpret mode)."""
+    import jax.numpy as jnp
+
+    from thunder_tpu.executors import pallasex as px
+    from thunder_tpu.transforms.quantization import dequantize_nf4_kl, quantize_nf4
+
+    rng = np.random.RandomState(0)
+    M, K, N = 8, 1024, 512
+    x = jnp.asarray(rng.randn(M, K), jnp.bfloat16)
+
+    w8 = jnp.asarray(np.clip(np.round(rng.randn(N, K) * 40), -127, 127), jnp.int8)
+    s8 = jnp.asarray(np.abs(rng.randn(N)) * 1e-3 + 1e-4, jnp.float32)
+    got8 = np.asarray(px.int8_linear(x, w8, s8), np.float32)
+    want8 = np.asarray(x, np.float32) @ (np.asarray(w8, np.float32) * np.asarray(s8)[:, None]).T
+    np.testing.assert_allclose(got8, want8, atol=2e-2, rtol=2e-2)
+
+    w = rng.randn(N, K).astype(np.float32) * 0.05
+    packed, absmax = quantize_nf4(jnp.asarray(w))
+    pkl, akl = px.pack_nf4_kernel_layout(packed, absmax, (N, K))
+    got4 = np.asarray(px.nf4_linear(x, pkl, akl), np.float32)
+    want4 = np.asarray(x, np.float32) @ np.asarray(
+        dequantize_nf4_kl(pkl, akl, (N, K)), np.float32).T
+    np.testing.assert_allclose(got4, want4, atol=2e-2, rtol=2e-2)
+
+    # adaptive block width (the llama MLP K)
+    K2 = 2816
+    assert px.nf4_kernel_block_k(K2) == 256
