@@ -1,8 +1,8 @@
 """Benchmark-as-test (reference thunder/benchmarks/targets.py runs as a
-pytest-benchmark suite): every registered target stays importable and the
-cheap ones execute end-to-end on CPU — a registry collision or a target
-whose body rotted (the round-3 dead-duplicate) fails here, not at bench
-time on the chip."""
+pytest-benchmark suite): every registered target executes end-to-end on CPU
+at clamped shapes — a registry collision, a target whose body rotted, or a
+shape literal that escapes the clamp fails here, not at bench time on the
+chip."""
 import numpy as np
 import pytest
 
@@ -15,28 +15,18 @@ def test_registry_nonempty_and_collision_guarded():
         targets.register("litgpt_gelu")(lambda rng: None)
 
 
-# cheap targets a CPU run can afford (small shapes, fast compiles; the
-# heavier targets run on chip via `python -m thunder_tpu.benchmarks.targets`)
-_CPU_SMOKE = [
-    "litgpt_gelu",
-    "litgpt_swiglu",
-]
-
-
-@pytest.mark.parametrize("name", _CPU_SMOKE)
+@pytest.mark.parametrize("name", sorted(targets.BENCHMARKS))
 def test_target_runs(name, monkeypatch):
-    # smoke semantics: one timed iteration at CLAMPED shapes (each dim <=256)
-    # — CI checks the target BUILDS and RUNS; the chip run does real timing
-    # at real shapes
+    # smoke semantics: one timed iteration with every dimension clamped to
+    # <=64 (targets._CLAMP) — CI checks each target BUILDS and RUNS; the
+    # chip run does real timing at real shapes
     real_timeit = targets._timeit
-    real_tensor = targets._tensor
+    monkeypatch.setattr(targets, "_CLAMP", 64)
     monkeypatch.setattr(targets, "_timeit",
                         lambda fn, *a, **kw: real_timeit(fn, *a, iters=1, warmup=0))
-    monkeypatch.setattr(targets, "_tensor",
-                        lambda rng, shape, dtype=None: real_tensor(
-                            rng, tuple(min(d, 256) for d in shape),
-                            *(() if dtype is None else (dtype,))))
     seconds = targets.BENCHMARKS[name](np.random.RandomState(0))
+    if isinstance(seconds, float) and np.isnan(seconds):
+        pytest.skip("target's optional dependency is unavailable")
     assert seconds is None or (isinstance(seconds, float) and seconds > 0)
 
 

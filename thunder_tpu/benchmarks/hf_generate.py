@@ -103,7 +103,7 @@ def generate_interop(model, config, prompt_ids: np.ndarray, new_tokens: int,
     # dispatch queue (one host sync at the end, not per token)
     t1 = time.perf_counter()
     for i in range(1, new_tokens - 1):
-        logits, ks, vs = step(nxt[:, None], jnp.asarray([T0 + 1 + i], jnp.int64), ks, vs)
+        logits, ks, vs = step(nxt[:, None], jnp.asarray([T0 + i], jnp.int64), ks, vs)
         nxt = jnp.argmax(logits, -1).astype(jnp.int64)
         toks_dev.append(nxt)
     jax.block_until_ready(nxt)
@@ -134,6 +134,47 @@ def generate_torch_eager(model, prompt_ids: np.ndarray, new_tokens: int):
     return tokens, prefill_s, decode_s_per_tok
 
 
+def logits_parity(model, config, prompt_ids: np.ndarray, steps: int = 8,
+                  max_cache_len: int = 128) -> float:
+    """Max-abs-err between interop and torch-eager *logits* along the decode
+    path, both fed torch's greedy tokens (identical inputs at every step) —
+    the decisive parity check, independent of argmax tie-breaking."""
+    import jax.numpy as jnp
+    import torch
+
+    from ..interop.torch_frontend import compile_torch_module
+
+    B, T0 = prompt_ids.shape
+    M = max_cache_len
+    H = config.n_head if hasattr(config, "n_head") else config.num_attention_heads
+    D = (config.n_embd if hasattr(config, "n_embd") else config.hidden_size) // H
+    L = config.n_layer if hasattr(config, "n_layer") else config.num_hidden_layers
+
+    step = compile_torch_module(build_static_step(model, config, M))
+    ks = tuple(jnp.zeros((B, H, M, D), jnp.float32) for _ in range(L))
+    vs = tuple(jnp.zeros((B, H, M, D), jnp.float32) for _ in range(L))
+
+    ids_t = torch.as_tensor(prompt_ids)
+    errs = []
+    with torch.no_grad():
+        out_t = model(input_ids=ids_t, use_cache=True)
+        past = out_t.past_key_values
+        logits_t = out_t.logits[:, -1, :]
+        logits_j, ks, vs = step(jnp.asarray(prompt_ids, jnp.int64),
+                                jnp.arange(T0, dtype=jnp.int64), ks, vs)
+        errs.append(float(jnp.max(jnp.abs(logits_j - jnp.asarray(logits_t.numpy())))))
+        nxt_t = logits_t.argmax(-1)
+        for i in range(steps):
+            out_t = model(input_ids=nxt_t[:, None], past_key_values=past, use_cache=True)
+            past = out_t.past_key_values
+            logits_t = out_t.logits[:, -1, :]
+            logits_j, ks, vs = step(jnp.asarray(nxt_t.numpy()[:, None], jnp.int64),
+                                    jnp.asarray([T0 + i], jnp.int64), ks, vs)
+            errs.append(float(jnp.max(jnp.abs(logits_j - jnp.asarray(logits_t.numpy())))))
+            nxt_t = logits_t.argmax(-1)
+    return max(errs)
+
+
 def run_gpt2(new_tokens: int = 64, prompt_len: int = 32, tiny: bool = False) -> dict:
     import torch
     from transformers import GPT2Config, GPT2LMHeadModel
@@ -147,7 +188,12 @@ def run_gpt2(new_tokens: int = 64, prompt_len: int = 32, tiny: bool = False) -> 
     tok_i, pre_i, dec_i = generate_interop(model, cfg, prompt, new_tokens)
     tok_e, pre_e, dec_e = generate_torch_eager(model, prompt, new_tokens)
     n_match = sum(a == b for a, b in zip(tok_i, tok_e))
+    # same max_cache_len as generate_interop so the parity probe reuses the
+    # persistent-cache executables instead of compiling a third shape
+    max_logit_err = logits_parity(model, cfg, prompt, steps=8,
+                                  max_cache_len=prompt_len + new_tokens)
     return {
+        "decode_logits_max_abs_err": round(max_logit_err, 6),
         "model": "gpt2-124M (real config, random init: zero-egress env)" if not tiny else "gpt2-tiny",
         "new_tokens": new_tokens,
         "prompt_len": prompt_len,

@@ -3,7 +3,12 @@
 Counterpart of reference thunder/benchmarks/targets.py:190-1010 (LitGPT GELU /
 SwiGLU / RMSNorm / SDPA / MLP / QKV+RoPE, nanoGPT blocks, full GPTs). Run as
 pytest (`pytest thunder_tpu/benchmarks/targets.py --benchmark-only` style) or
-directly: `python -m thunder_tpu.benchmarks.targets [pattern]`."""
+directly: `python -m thunder_tpu.benchmarks.targets [pattern]`.
+
+Every target derives its shapes through ``_d()`` and its model configs through
+the ``_*_cfg`` helpers, so the CPU smoke test can clamp the whole suite to
+tiny shapes (``_CLAMP``) and run all targets end-to-end — no hard-coded
+literals that break under clamping."""
 from __future__ import annotations
 
 import math
@@ -18,6 +23,30 @@ import numpy as np
 import thunder_tpu as tt
 from thunder_tpu import nn, optim
 from thunder_tpu.ops import ltorch
+
+# smoke mode: when set, every shape dimension is capped here and model
+# configs collapse to their tiny "test" variants — the CPU suite runs all
+# targets end-to-end in seconds (real timing happens on chip, unclamped)
+_CLAMP: int | None = None
+
+
+def _d(n: int) -> int:
+    """A shape dimension, capped in smoke mode."""
+    return n if _CLAMP is None else min(n, _CLAMP)
+
+
+def _litgpt_cfg(name: str, **overrides):
+    from thunder_tpu.models.litgpt import Config
+
+    if _CLAMP is not None:
+        return Config.from_name("tiny-llama2")
+    return Config.from_name(name, **overrides)
+
+
+def _nanogpt_cfg(name: str):
+    from thunder_tpu.models.nanogpt import configs
+
+    return configs["test" if _CLAMP is not None else name]
 
 
 def _force(out):
@@ -67,75 +96,79 @@ def register(name):
 
 @register("litgpt_gelu")
 def bench_gelu(rng):
-    x = _tensor(rng, (16, 2048, 4096))
+    x = _tensor(rng, (_d(16), _d(2048), _d(4096)))
     cf = _jit(lambda x: ltorch.gelu(x, approximate="tanh"))
     return _timeit(cf, x)
 
 
 @register("litgpt_swiglu")
 def bench_swiglu(rng):
-    gate = _tensor(rng, (8, 2048, 11008))
-    up = _tensor(rng, (8, 2048, 11008))
+    gate = _tensor(rng, (_d(8), _d(2048), _d(11008)))
+    up = _tensor(rng, (_d(8), _d(2048), _d(11008)))
     cf = _jit(lambda g, u: ltorch.silu(g) * u)
     return _timeit(cf, gate, up)
 
 
 @register("litgpt_rmsnorm")
 def bench_rmsnorm(rng):
-    x = _tensor(rng, (16, 2048, 4096))
-    w = jnp.ones((4096,), jnp.bfloat16)
-    cf = _jit(lambda x, w: ltorch.rms_norm(x, (4096,), w))
+    D = _d(4096)
+    x = _tensor(rng, (_d(16), _d(2048), D))
+    w = jnp.ones((D,), jnp.bfloat16)
+    cf = _jit(lambda x, w: ltorch.rms_norm(x, (D,), w))
     return _timeit(cf, x, w)
 
 
 @register("litgpt_sdpa")
 def bench_sdpa(rng):
-    q = _tensor(rng, (8, 32, 2048, 128))
-    k = _tensor(rng, (8, 32, 2048, 128))
-    v = _tensor(rng, (8, 32, 2048, 128))
+    B, H, T, D = _d(8), _d(32), _d(2048), _d(128)
+    q = _tensor(rng, (B, H, T, D))
+    k = _tensor(rng, (B, H, T, D))
+    v = _tensor(rng, (B, H, T, D))
     cf = _jit(lambda q, k, v: ltorch.sdpa(q, k, v, is_causal=True))
     return _timeit(cf, q, k, v, iters=10)
 
 
 @register("litgpt_mlp")
 def bench_mlp(rng):
-    from thunder_tpu.models.litgpt import Config, LLaMAMLP
+    from thunder_tpu.models.litgpt import LLaMAMLP
 
-    cfg = Config.from_name("Llama-2-7b-hf")
+    cfg = _litgpt_cfg("Llama-2-7b-hf")
     mlp = LLaMAMLP(cfg, dtype=jnp.bfloat16)
     tm = _jit(mlp)
-    x = _tensor(rng, (4, 2048, cfg.n_embd))
+    x = _tensor(rng, (_d(4), min(_d(2048), cfg.block_size), cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
 
 @register("nanogpt_block")
 def bench_nanogpt_block(rng):
-    from thunder_tpu.models.nanogpt import NanoBlock, NanoGPTConfig
+    from thunder_tpu.models.nanogpt import NanoBlock
 
-    cfg = NanoGPTConfig()
+    cfg = _nanogpt_cfg("gpt2")
     blk = NanoBlock(cfg, dtype=jnp.bfloat16)
     tm = _jit(blk)
-    x = _tensor(rng, (8, 1024, cfg.n_embd))
+    x = _tensor(rng, (_d(8), min(_d(1024), cfg.block_size), cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
 
 @register("nanogpt_gpt2_fwd")
 def bench_gpt2_fwd(rng):
-    from thunder_tpu.models.nanogpt import NanoGPT, configs
+    from thunder_tpu.models.nanogpt import NanoGPT
 
-    model = NanoGPT(configs["gpt2"], dtype=jnp.bfloat16)
+    cfg = _nanogpt_cfg("gpt2")
+    model = NanoGPT(cfg, dtype=jnp.bfloat16)
     tm = _jit(model)
-    idx = jnp.asarray(rng.randint(0, 50000, (4, 1024)), jnp.int32)
+    T = min(_d(1024), cfg.block_size)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (_d(4), T)), jnp.int32)
     return _timeit(tm, idx, iters=5)
 
 
 @register("litgpt_qkv_rope")
 def bench_qkv_rope(rng):
     """QKV projection + split + RoPE (reference targets.py litgpt qkv+rope)."""
-    from thunder_tpu.models.litgpt import Config, build_rope_cache, _apply_rope
+    from thunder_tpu.models.litgpt import build_rope_cache, _apply_rope
 
-    cfg = Config.from_name("Llama-2-7b-hf")
-    T = 2048
+    cfg = _litgpt_cfg("Llama-2-7b-hf")
+    T = min(_d(2048), cfg.block_size)
     w = _tensor(rng, ((cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size, cfg.n_embd))
     x = _tensor(rng, (1, T, cfg.n_embd))
     cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
@@ -154,21 +187,23 @@ def bench_qkv_rope(rng):
 
 @register("fused_cross_entropy")
 def bench_cross_entropy(rng):
-    logits = _tensor(rng, (8192, 32000), jnp.float32)
-    tgt = jnp.asarray(rng.randint(0, 32000, (8192,)), jnp.int32)
+    N, V = _d(8192), _d(32000)
+    logits = _tensor(rng, (N, V), jnp.float32)
+    tgt = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
     cf = _jit(lambda l, t: ltorch.cross_entropy(l, t))
     return _timeit(cf, logits, tgt, iters=10)
 
 
 @register("train_step_tiny_gpt")
 def bench_train_step(rng):
-    from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+    from thunder_tpu.models.litgpt import GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
-    cfg = Config.from_name("tiny-llama2")
+    cfg = _litgpt_cfg("tiny-llama2")
     step = TrainStep(GPTForCausalLM(cfg), optim.AdamW(lr=1e-4))
-    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128)), jnp.int32)
-    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 128)), jnp.int32)
+    T = min(_d(128), cfg.block_size)
+    idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (_d(4), T)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (_d(4), T)), jnp.int32)
     step(idx, tgt)  # compile
 
     def run(i, t):
@@ -181,9 +216,9 @@ def bench_train_step(rng):
 def bench_resnet50(rng):
     from thunder_tpu.models.resnet import build
 
-    model = build("resnet50", dtype=jnp.bfloat16)
+    model = build("test" if _CLAMP is not None else "resnet50", dtype=jnp.bfloat16)
     tm = _jit(model)
-    x = _tensor(rng, (8, 3, 224, 224))
+    x = _tensor(rng, (_d(8), 3, _d(224), _d(224)))
     return _timeit(tm, x, iters=5)
 
 
@@ -191,10 +226,10 @@ def bench_resnet50(rng):
 def bench_moe_block(rng):
     from thunder_tpu.models.moe import MoEConfig, MoEMLP
 
-    cfg = MoEConfig(n_embd=1024, n_expert=8, n_expert_per_token=2)
+    cfg = MoEConfig(n_embd=_d(1024), n_expert=8, n_expert_per_token=2)
     mlp = MoEMLP(cfg, dtype=jnp.bfloat16)
     tm = _jit(mlp)
-    x = _tensor(rng, (8, 512, cfg.n_embd))
+    x = _tensor(rng, (_d(8), _d(512), cfg.n_embd))
     return _timeit(tm, x, iters=10)
 
 
@@ -202,9 +237,10 @@ def bench_moe_block(rng):
 def bench_vit(rng):
     from thunder_tpu.models.vit import ViT, configs
 
-    model = ViT(configs["vit-b16"], dtype=jnp.bfloat16)
+    cfg = configs["test" if _CLAMP is not None else "vit-b16"]
+    model = ViT(cfg, dtype=jnp.bfloat16)
     tm = _jit(model)
-    x = _tensor(rng, (8, 3, 224, 224))
+    x = _tensor(rng, (_d(8), cfg.channels, cfg.image_size, cfg.image_size))
     return _timeit(tm, x, iters=5)
 
 
@@ -212,37 +248,39 @@ def bench_vit(rng):
 def bench_llama2_7b_attention(rng):
     """One Llama-2-7B attention layer at full dims (reference targets.py
     llama2 7B attention target)."""
-    from thunder_tpu.models.litgpt import CausalSelfAttention, Config, build_rope_cache
+    from thunder_tpu.models.litgpt import CausalSelfAttention, build_rope_cache
 
-    cfg = Config.from_name("Llama-2-7b-hf", block_size=2048)
+    cfg = _litgpt_cfg("Llama-2-7b-hf", block_size=2048)
     attn = CausalSelfAttention(cfg, dtype=jnp.bfloat16)
     tm = _jit(attn)
-    x = _tensor(rng, (1, 2048, cfg.n_embd))
-    cos, sin = build_rope_cache(2048, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+    T = min(_d(2048), cfg.block_size)
+    x = _tensor(rng, (1, T, cfg.n_embd))
+    cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
     return _timeit(tm, x, cos, sin, iters=5)
 
 
 @register("llama_mlp_7b")
 def bench_llama_mlp_7b(rng):
-    from thunder_tpu.models.litgpt import Config, LLaMAMLP
+    from thunder_tpu.models.litgpt import LLaMAMLP
 
-    cfg = Config.from_name("Llama-2-7b-hf")
+    cfg = _litgpt_cfg("Llama-2-7b-hf")
     mlp = LLaMAMLP(cfg, dtype=jnp.bfloat16)
     tm = _jit(mlp)
-    x = _tensor(rng, (1, 2048, cfg.n_embd))
+    x = _tensor(rng, (1, min(_d(2048), cfg.block_size), cfg.n_embd))
     return _timeit(tm, x, iters=5)
 
 
 @register("gpt2_xl_block")
 def bench_gpt2_xl_block(rng):
     """GPT-2 XL dims block fwd (reference nanogpt/gpt2-xl family)."""
-    from thunder_tpu.models.litgpt import Block, Config, build_rope_cache
+    from thunder_tpu.models.litgpt import Block, build_rope_cache
 
-    cfg = Config.from_name("nanogpt-124m", n_embd=1600, n_head=25, block_size=1024)
+    cfg = _litgpt_cfg("nanogpt-124m", n_embd=1600, n_head=25, block_size=1024)
     blk = Block(cfg, dtype=jnp.bfloat16)
     tm = _jit(blk)
-    x = _tensor(rng, (4, 1024, 1600))
-    cos, sin = build_rope_cache(1024, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
+    T = min(_d(1024), cfg.block_size)
+    x = _tensor(rng, (_d(4), T, cfg.n_embd))
+    cos, sin = build_rope_cache(T, cfg.rope_n_elem, cfg.rope_base, jnp.bfloat16)
     return _timeit(tm, x, cos, sin, iters=5)
 
 
@@ -255,12 +293,13 @@ def bench_hf_gpt2(rng):
         from transformers import GPT2Config, GPT2LMHeadModel
     except Exception:
         return float("nan")
-    cfg = GPT2Config(n_layer=4, n_head=8, n_embd=512, vocab_size=50257,
-                     n_positions=512, use_cache=False)
+    V, T = _d(50257), _d(512)
+    cfg = GPT2Config(n_layer=2 if _CLAMP else 4, n_head=8, n_embd=_d(512),
+                     vocab_size=V, n_positions=T, use_cache=False)
     torch.manual_seed(0)
     model = GPT2LMHeadModel(cfg).eval()
     ctm = tt.jit(model)
-    ids = jnp.asarray(rng.randint(0, 50257, (4, 512)), jnp.int32)
+    ids = jnp.asarray(rng.randint(0, V, (_d(4), T)), jnp.int32)
 
     def run(i):
         out = ctm(input_ids=i, use_cache=False)
@@ -276,14 +315,16 @@ def bench_hf_llama(rng):
         from transformers import LlamaConfig, LlamaForCausalLM
     except Exception:
         return float("nan")
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=512, intermediate_size=1376,
-                      num_hidden_layers=4, num_attention_heads=8,
-                      num_key_value_heads=8, use_cache=False,
-                      max_position_embeddings=1024)
+    V, T = _d(32000), _d(512)
+    cfg = LlamaConfig(vocab_size=V, hidden_size=_d(512),
+                      intermediate_size=_d(1376),
+                      num_hidden_layers=2 if _CLAMP else 4,
+                      num_attention_heads=8, num_key_value_heads=8,
+                      use_cache=False, max_position_embeddings=_d(1024))
     torch.manual_seed(0)
     model = LlamaForCausalLM(cfg).eval()
     ctm = tt.jit(model)
-    ids = jnp.asarray(rng.randint(0, 32000, (2, 512)), jnp.int32)
+    ids = jnp.asarray(rng.randint(0, V, (_d(2), T)), jnp.int32)
 
     def run(i):
         out = ctm(input_ids=i)
@@ -302,7 +343,8 @@ def bench_adamw_update(rng):
 
     # few large tensors: per-arg dispatch marshaling on the tunnel would
     # otherwise dominate (the real step passes params as one fused program)
-    shapes = [(50304, 768)] + [(12, 768, 3072)] + [(12, 3072, 768)] + [(48, 768, 768)]
+    shapes = [(_d(50304), _d(768)), (_d(12), _d(768), _d(3072)),
+              (_d(12), _d(3072), _d(768)), (_d(48), _d(768), _d(768))]
     params = {f"p{i}": _tensor(rng, s, jnp.float32) for i, s in enumerate(shapes)}
     grads = {k: _tensor(rng, v.shape, jnp.float32) for k, v in params.items()}
     opt = optim.AdamW(lr=1e-4)
@@ -321,7 +363,7 @@ def bench_adamw_update(rng):
 def bench_embedding_lmhead(rng):
     """Embedding gather + LM-head matmul + fused xent — the vocab-bound tail
     of every LM step."""
-    V, D, N = 32000, 1024, 8192
+    V, D, N = _d(32000), _d(1024), _d(8192)
     wte = _tensor(rng, (V, D))
     ids = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
     tgt = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
@@ -337,12 +379,13 @@ def bench_embedding_lmhead(rng):
 
 @register("layer_norm_bwd")
 def bench_layer_norm_bwd(rng):
-    x = _tensor(rng, (8192, 1024), jnp.float32)
-    w = _tensor(rng, (1024,), jnp.float32)
-    b = _tensor(rng, (1024,), jnp.float32)
+    N, D = _d(8192), _d(1024)
+    x = _tensor(rng, (N, D), jnp.float32)
+    w = _tensor(rng, (D,), jnp.float32)
+    b = _tensor(rng, (D,), jnp.float32)
 
     def loss(x, w, b):
-        return ltorch.sum(ltorch.layer_norm(x, (1024,), w, b))
+        return ltorch.sum(ltorch.layer_norm(x, (D,), w, b))
 
     vag = tt.value_and_grad(loss)
     vag(x, w, b)
@@ -355,11 +398,12 @@ def bench_layer_norm_bwd(rng):
 
 @register("rmsnorm_bwd")
 def bench_rmsnorm_bwd(rng):
-    x = _tensor(rng, (8192, 1024), jnp.float32)
-    w = _tensor(rng, (1024,), jnp.float32)
+    N, D = _d(8192), _d(1024)
+    x = _tensor(rng, (N, D), jnp.float32)
+    w = _tensor(rng, (D,), jnp.float32)
 
     def loss(x, w):
-        return ltorch.sum(ltorch.rms_norm(x, (1024,), w))
+        return ltorch.sum(ltorch.rms_norm(x, (D,), w))
 
     vag = tt.value_and_grad(loss)
     vag(x, w)
@@ -371,10 +415,10 @@ def bench_deepseek_moe(rng):
     """Larger expert count + top-k routing (reference DeepSeek MoE target)."""
     from thunder_tpu.models.moe import MoEConfig, MoEMLP
 
-    cfg = MoEConfig(n_embd=1024, n_expert=32, n_expert_per_token=4)
+    cfg = MoEConfig(n_embd=_d(1024), n_expert=32, n_expert_per_token=4)
     mlp = MoEMLP(cfg, dtype=jnp.bfloat16)
     tm = _jit(mlp)
-    x = _tensor(rng, (4, 512, cfg.n_embd))
+    x = _tensor(rng, (_d(4), _d(512), cfg.n_embd))
     return _timeit(tm, x, iters=5)
 
 

@@ -340,9 +340,11 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4)
 
-    if g == 1:
+    if g == 1 or pltpu is None:
         # MHA fast path: full-T q/do resident per program (measured faster
-        # than the streaming grid at llama-350m shapes)
+        # than the streaming grid at llama-350m shapes). Also the GQA route
+        # when the TPU pallas namespace is unavailable (no VMEM scratch for
+        # the streaming kernel): per-q-head dk/dv, group-summed below.
         dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_dkv_kernel_mha, block_q=block_q, causal=causal, scale=scale),
             grid=(B, H, Tk // block_k),
@@ -364,6 +366,9 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
             ],
             interpret=_interpret(),
         )(q, k, v, do, lse4, delta4)
+        if g > 1:
+            dk = dk.reshape(B, Hkv, g, Tk, D).sum(2).astype(k.dtype)
+            dv = dv.reshape(B, Hkv, g, Tk, D).sum(2).astype(v.dtype)
         return dq, dk, dv
 
     # GQA: q heads grouped per kv head — view q/do/lse/delta as (B, Hkv, g, T, ...)
@@ -671,8 +676,9 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
         interpret=_interpret(),
     )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
 
-    if g == 1:
-        # MHA fast path (see flash_attention_backward)
+    if g == 1 or pltpu is None:
+        # MHA fast path (see flash_attention_backward); doubles as the GQA
+        # no-pltpu fallback — per-q-head dk/dv, group-summed below
         dk, dv = pl.pallas_call(
             functools.partial(_flash_rope_bwd_dkv_kernel_mha, block_q=block_q, causal=causal, scale=scale),
             grid=(B, H, T // block_k),
@@ -698,6 +704,9 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
             ],
             interpret=_interpret(),
         )(q, k, v, do, lse4, delta4, cos, sin, cos, sin)
+        if g > 1:
+            dk = dk.reshape(B, Hkv, g, T, D).sum(2).astype(k.dtype)
+            dv = dv.reshape(B, Hkv, g, T, D).sum(2).astype(v.dtype)
         return dq, dk, dv
 
     qg = q.reshape(B, Hkv, g, T, D)
@@ -1122,7 +1131,7 @@ def _int8_linear_supported(x, qweight, scale, bias=None):
     # whole-M block (no M grid): claim the serving/decode regime; huge-M
     # prefill/training shapes stay on the XLA path (compute-bound there)
     return (
-        str(getattr(qweight, "dtype", "")).endswith("int8")
+        str(getattr(qweight, "dtype", "")) == "int8"
         and x.shape[-1] == K
         and K % 128 == 0 and K <= 8192
         and N % 128 == 0

@@ -1352,7 +1352,9 @@ def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
     scores = jnp.einsum("...qd,...kd->...qk", query, key) * s
     if is_causal:
         L, S = query.shape[-2], key.shape[-2]
-        causal = jnp.tril(jnp.ones((L, S), bool), k=S - L)
+        # torch documents a top-left-aligned causal mask (tril diagonal=0)
+        # even when L != S
+        causal = jnp.tril(jnp.ones((L, S), bool))
         scores = jnp.where(causal, scores, -jnp.inf)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:

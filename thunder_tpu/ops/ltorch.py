@@ -891,7 +891,9 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, en
         Lq, Lk = q.shape[-2], k.shape[-2]
         r = clang.unsqueeze(prims.iota(Lq, dtype=dtypes.int32, device=q.device), 1)
         c = clang.unsqueeze(prims.iota(Lk, dtype=dtypes.int32, device=q.device), 0)
-        causal = clang.ge(clang.add(r, Lk - Lq), c)
+        # torch documents a top-left-aligned causal mask (tril diagonal=0)
+        # even when Lq != Lk
+        causal = clang.ge(r, c)
         scores = clang.where(causal, scores, float("-inf"))
     if attn_mask is not None:
         if attn_mask.dtype.is_bool:

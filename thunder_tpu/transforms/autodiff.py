@@ -701,14 +701,30 @@ def _prod_aug(a, dims, *, output_dtype=None):
 
 @register_backward(PrimIDs.PROD)
 def _prod_bwd(a, out, dims, g):
-    # d prod / d a_i = prod / a_i (torch semantics; matches jax for nonzero a)
+    # d prod / d a_i = g * prod_{j != i} a_j, kept finite for zero-containing
+    # inputs (torch semantics): one zero in a reduced group -> only that
+    # position gets the product of the other elements; two or more -> all 0.
     kept = tuple(d for d in range(len(a.shape)) if d not in dims)
     g_full = prims.broadcast_in_dim(g, a.shape, kept)
-    out_full = prims.broadcast_in_dim(out, a.shape, kept)
     if g_full.dtype != a.dtype:
         g_full = prims.convert_element_type(g_full, a.dtype)
-        out_full = prims.convert_element_type(out_full, a.dtype)
-    return prims.div(prims.mul(g_full, out_full), a)
+    zero = _zeros_like(a)
+    one = clang.full_like(a, 1)
+    is_zero = prims.eq(a, zero)
+    safe_a = prims.where(is_zero, one, a)
+    # product over the reduced dims with zeros replaced by ones
+    prod_nz = prims.broadcast_in_dim(prims.prod_prim(safe_a, dims), a.shape, kept)
+    nz_dtype = g_full.dtype
+    n_zeros = prims.broadcast_in_dim(
+        prims.sum_prim(prims.convert_element_type(is_zero, nz_dtype), dims),
+        a.shape, kept)
+    nz0 = _zeros_like(n_zeros)
+    nz1 = clang.full_like(n_zeros, 1)
+    grad_no_zero = prims.mul(g_full, prims.div(prod_nz, safe_a))
+    grad_one_zero = prims.where(is_zero, prims.mul(g_full, prod_nz), zero)
+    grad = prims.where(prims.eq(n_zeros, nz0), grad_no_zero,
+                       prims.where(prims.eq(n_zeros, nz1), grad_one_zero, zero))
+    return grad
 
 
 @register_augmented_forward(PrimIDs.LOG10)
