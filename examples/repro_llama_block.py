@@ -1,0 +1,47 @@
+"""thunder_tpu reproducer — auto-generated (utils/report.py).
+
+fn: <thunder_tpu.nn.module.ThunderModule object at 0x7fdd2853a420>
+trace: Block_forward
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import thunder_tpu
+import thunder_tpu.core.dtypes
+import thunder_tpu.core.devices
+from thunder_tpu.core.trace_exec import make_trace_namespace
+
+import os as _os
+_DATA = (np.load(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)), 'repro_llama_block.py.npz')) if False else None)
+
+SRC = 'def Block_forward(t0, t1, t2, t3, t4, t5, t6, t7, t8, t9):\n  t21 = ltorch.rms_norm(t7, (128,), t5, 1e-05)  # t21: cpu:0 f32[2, 64, 128]\n  t22 = ltorch.linear(t21, t0, None)  # t22: cpu:0 f32[2, 64, 256]\n  t23 = ltorch.reshape(t22, (2, 64, 2, 4, 32))  # t23: cpu:0 f32[2, 64, 2, 4, 32]\n  t24 = ltorch.getitem(t23, (slice(None, None, None), slice(None, None, None), slice(None, None, None), slice(None, 2, None), slice(None, None, None)))  # t24: cpu:0 f32[2, 64, 2, 2, 32]\n  t25 = ltorch.getitem(t23, (slice(None, None, None), slice(None, None, None), slice(None, None, None), slice(2, 3, None), slice(None, None, None)))  # t25: cpu:0 f32[2, 64, 2, 1, 32]\n  t26 = ltorch.getitem(t23, (slice(None, None, None), slice(None, None, None), slice(None, None, None), slice(3, None, None), slice(None, None, None)))  # t26: cpu:0 f32[2, 64, 2, 1, 32]\n  t27 = ltorch.reshape(t24, (2, 64, 4, 32))  # t27: cpu:0 f32[2, 64, 4, 32]\n  t28 = ltorch.reshape(t25, (2, 64, 2, 32))  # t28: cpu:0 f32[2, 64, 2, 32]\n  t29 = ltorch.reshape(t26, (2, 64, 2, 32))  # t29: cpu:0 f32[2, 64, 2, 32]\n  t30 = ltorch.permute(t27, (0, 2, 1, 3))  # t30: cpu:0 f32[2, 4, 64, 32]\n  t31 = ltorch.permute(t28, (0, 2, 1, 3))  # t31: cpu:0 f32[2, 2, 64, 32]\n  t32 = ltorch.permute(t29, (0, 2, 1, 3))  # t32: cpu:0 f32[2, 2, 64, 32]\n  t92 = ltorch.rope_sdpa(t30, t31, t32, t8, t9, is_causal=True, scale=0.17677669529663687)  # t92: cpu:0 f32[2, 4, 64, 32]\n  t93 = ltorch.permute(t92, (0, 2, 1, 3))  # t93: cpu:0 f32[2, 64, 4, 32]\n  t94 = ltorch.reshape(t93, (2, 64, 128))  # t94: cpu:0 f32[2, 64, 128]\n  t95 = ltorch.linear(t94, t1, None)  # t95: cpu:0 f32[2, 64, 128]\n  t96 = ltorch.add(t7, t95)  # t96: cpu:0 f32[2, 64, 128]\n  t108 = ltorch.rms_norm(t96, (128,), t6, 1e-05)  # t108: cpu:0 f32[2, 64, 128]\n  t109 = ltorch.linear(t108, t2, None)  # t109: cpu:0 f32[2, 64, 352]\n  t116 = ltorch.silu(t109)  # t116: cpu:0 f32[2, 64, 352]\n  t117 = ltorch.linear(t108, t3, None)  # t117: cpu:0 f32[2, 64, 352]\n  t118 = ltorch.mul(t116, t117)  # t118: cpu:0 f32[2, 64, 352]\n  t119 = ltorch.linear(t118, t4, None)  # t119: cpu:0 f32[2, 64, 128]\n  t120 = ltorch.add(t96, t119)  # t120: cpu:0 f32[2, 64, 128]\n  return t120'
+
+INPUT_SPECS = [('t0', (256, 128), 'float32'), ('t1', (128, 128), 'float32'), ('t2', (352, 128), 'float32'), ('t3', (352, 128), 'float32'), ('t4', (128, 352), 'float32'), ('t5', (128,), 'float32'), ('t6', (128,), 'float32'), ('t7', (2, 64, 128), 'float32'), ('t8', (64, 32), 'float32'), ('t9', (64, 32), 'float32')]
+
+
+def make_inputs(seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for name, shape, dtype in INPUT_SPECS:
+        if shape is None:
+            out.append({'int': 1, 'bool': True}.get(dtype, 0.5))
+        elif dtype.startswith('int') or dtype.startswith('uint'):
+            out.append(jnp.asarray(rng.randint(0, 10, shape), 'int32'))
+        elif dtype == 'bool8':
+            out.append(jnp.asarray(rng.rand(*shape) > 0.5))
+        else:
+            out.append(jnp.asarray(rng.randn(*shape), dtype))
+    return out
+
+
+ns = make_trace_namespace()
+for _k in dir():
+    if _k.startswith('_dtype') or _k.startswith('_dev') or _k.startswith('_c') or _k.startswith('_obj'):
+        ns[_k] = globals()[_k]
+
+if __name__ == '__main__':
+    exec(compile(SRC, 'repro', 'exec'), ns)
+    fn = ns['Block_forward']
+    outs = fn(*make_inputs())
+    print(jax.tree_util.tree_map(lambda t: getattr(t, 'shape', t), outs))

@@ -1328,11 +1328,11 @@ def _err_matmul(rng):
 
 
 def _err_reshape(rng):
-    yield (make_tensor(rng, (3, 4), dtypes.float32), (5, 5)), {}, RuntimeError, "reshape|mismatch"
+    yield (make_tensor(rng, (3, 4), dtypes.float32), (5, 5)), {}, RuntimeError, "element count mismatch"
 
 
 def _err_cat(rng):
-    yield ([make_tensor(rng, (2, 3), dtypes.float32), make_tensor(rng, (2, 3, 4), dtypes.float32)], 0), {}, RuntimeError, "rank|cat"
+    yield ([make_tensor(rng, (2, 3), dtypes.float32), make_tensor(rng, (2, 3, 4), dtypes.float32)], 0), {}, RuntimeError, "cat rank mismatch"
 
 
 def _err_squeeze(rng):
@@ -1384,7 +1384,7 @@ def _t(rng, *shape):
 
 
 def _err_add(rng):
-    yield (_t(rng, 3, 4), _t(rng, 2, 5)), {}, RuntimeError, "broadcast|shape"
+    yield (_t(rng, 3, 4), _t(rng, 2, 5)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_bmm(rng):
@@ -1392,134 +1392,134 @@ def _err_bmm(rng):
 
 
 def _err_mv(rng):
-    yield (_t(rng, 3, 4), _t(rng, 5)), {}, RuntimeError, "matmul|shape|contract"
+    yield (_t(rng, 3, 4), _t(rng, 5)), {}, RuntimeError, "matmul:"
 
 
 def _err_linear_bias(rng):
-    yield (_t(rng, 2, 8), _t(rng, 4, 8), _t(rng, 5)), {}, RuntimeError, "bias|shape"
+    yield (_t(rng, 2, 8), _t(rng, 4, 8), _t(rng, 5)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_embedding(rng):
-    yield (_t(rng, 2, 3), _t(rng, 5, 4)), {}, ValueError, "int|index|dtype"
+    yield (_t(rng, 2, 3), _t(rng, 5, 4)), {}, ValueError, "indices must have an integer type"
 
 
 def _err_gather(rng):
-    yield (_t(rng, 3, 4), 5, jnp.zeros((3, 4), jnp.int32)), {}, IndexError, "dim|range"
+    yield (_t(rng, 3, 4), 5, jnp.zeros((3, 4), jnp.int32)), {}, IndexError, "out of range for rank"
 
 
 def _err_index_select(rng):
-    yield (_t(rng, 3, 4), 0, jnp.zeros((2, 2), jnp.int32)), {}, RuntimeError, "1-?d|index|vector"
-    yield (_t(rng, 3, 4), 7, jnp.zeros((2,), jnp.int32)), {}, IndexError, "dim|range"
+    yield (_t(rng, 3, 4), 0, jnp.zeros((2, 2), jnp.int32)), {}, RuntimeError, "1-D index vector"
+    yield (_t(rng, 3, 4), 7, jnp.zeros((2,), jnp.int32)), {}, IndexError, "out of range for rank"
 
 
 def _err_cat_dim(rng):
-    yield ([_t(rng, 2, 3), _t(rng, 2, 3)], 5), {}, IndexError, "dim|range"
-    yield ([], 0), {}, RuntimeError, "empty|at least"
+    yield ([_t(rng, 2, 3), _t(rng, 2, 3)], 5), {}, IndexError, "out of range for rank"
+    yield ([], 0), {}, RuntimeError, "at least one tensor"
 
 
 def _err_stack(rng):
-    yield ([_t(rng, 2, 3), _t(rng, 2, 4)],), {}, RuntimeError, "shape|same"
+    yield ([_t(rng, 2, 3), _t(rng, 2, 4)],), {}, RuntimeError, "tensors of the same shape"
 
 
 def _err_split(rng):
-    yield (_t(rng, 6, 2), [2, 5]), {}, RuntimeError, "size|sum|split"
+    yield (_t(rng, 6, 2), [2, 5]), {}, RuntimeError, "must sum to dim"
 
 
 def _err_transpose(rng):
-    yield (_t(rng, 3, 4), 0, 5), {}, IndexError, "dim|range"
+    yield (_t(rng, 3, 4), 0, 5), {}, IndexError, "out of range for rank"
 
 
 def _err_permute(rng):
-    yield (_t(rng, 2, 3, 4), (0, 1)), {}, RuntimeError, "permut|rank|length"
-    yield (_t(rng, 2, 3, 4), (0, 1, 1)), {}, RuntimeError, "permut|dup|repeat"
+    yield (_t(rng, 2, 3, 4), (0, 1)), {}, RuntimeError, "invalid permutation"
+    yield (_t(rng, 2, 3, 4), (0, 1, 1)), {}, RuntimeError, "invalid permutation"
 
 
 def _err_expand(rng):
-    yield (_t(rng, 2, 3), (4, 3)), {}, RuntimeError, "expand|broadcast|size"
+    yield (_t(rng, 2, 3), (4, 3)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_reshape_ambiguous(rng):
-    yield (_t(rng, 4, 6), (-1, -1)), {}, RuntimeError, "-1|infer"
+    yield (_t(rng, 4, 6), (-1, -1)), {}, RuntimeError, "at most one dimension"
 
 
 def _err_unsqueeze(rng):
-    yield (_t(rng, 2, 3), 6), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3), 6), {}, IndexError, "out of range for rank"
 
 
 def _err_flatten(rng):
-    yield (_t(rng, 2, 3, 4),), {"start_dim": 2, "end_dim": 1}, RuntimeError, "start|end|dim"
+    yield (_t(rng, 2, 3, 4),), {"start_dim": 2, "end_dim": 1}, RuntimeError, "must be <= end_dim"
 
 
 def _err_softmax(rng):
-    yield (_t(rng, 2, 3), 5), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3), 5), {}, IndexError, "out of range for rank"
 
 
 def _err_layer_norm(rng):
-    yield (_t(rng, 2, 8), (7,)), {}, RuntimeError, "normalized|shape"
+    yield (_t(rng, 2, 8), (7,)), {}, RuntimeError, "normalized_shape"
 
 
 def _err_group_norm(rng):
-    yield (_t(rng, 2, 6, 4), 4), {}, RuntimeError, "group|divis|channel"
+    yield (_t(rng, 2, 6, 4), 4), {}, RuntimeError, "channels not divisible"
 
 
 def _err_nll_loss(rng):
-    yield (_t(rng, 4, 5), jnp.zeros((3,), jnp.int32)), {}, RuntimeError, "batch|shape|size"
+    yield (_t(rng, 4, 5), jnp.zeros((3,), jnp.int32)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_topk(rng):
-    yield (_t(rng, 5), 9), {}, ValueError, "k|size|range"
+    yield (_t(rng, 5), 9), {}, ValueError, "no larger than size along axis"
 
 
 def _err_scatter(rng):
-    yield (_t(rng, 3, 4), 9, jnp.zeros((3, 4), jnp.int32), _t(rng, 3, 4)), {}, IndexError, "dim|range"
+    yield (_t(rng, 3, 4), 9, jnp.zeros((3, 4), jnp.int32), _t(rng, 3, 4)), {}, IndexError, "out of range for rank"
 
 
 def _err_pad(rng):
-    yield (_t(rng, 2, 3), (1, 2, 3)), {}, RuntimeError, "pad|even|pairs"
+    yield (_t(rng, 2, 3), (1, 2, 3)), {}, RuntimeError, "even number of pad values"
 
 
 def _err_where(rng):
-    yield (jnp.zeros((2, 3), bool), _t(rng, 4, 5), _t(rng, 2, 3)), {}, RuntimeError, "broadcast|shape"
+    yield (jnp.zeros((2, 3), bool), _t(rng, 4, 5), _t(rng, 2, 3)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_masked_fill(rng):
-    yield (_t(rng, 2, 3), _t(rng, 2, 3), 0.0), {}, RuntimeError, "bool|mask"
+    yield (_t(rng, 2, 3), _t(rng, 2, 3), 0.0), {}, RuntimeError, "expects a bool mask"
 
 
 def _err_take_along(rng):
-    yield (_t(rng, 3, 4), jnp.zeros((3,), jnp.int32), 1), {}, RuntimeError, "ndim|rank|dim"
+    yield (_t(rng, 3, 4), jnp.zeros((3,), jnp.int32), 1), {}, RuntimeError, "must match input rank"
 
 
 def _err_cumsum(rng):
-    yield (_t(rng, 2, 3), 4), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3), 4), {}, IndexError, "out of range for rank"
 
 
 def _err_argmax(rng):
-    yield (_t(rng, 2, 3), 5), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3), 5), {}, IndexError, "out of range for rank"
 
 
 def _err_chunk(rng):
-    yield (_t(rng, 6), 0), {}, RuntimeError, "chunk|positive"
+    yield (_t(rng, 6), 0), {}, RuntimeError, "positive number of chunks"
 
 
 def _err_unflatten(rng):
-    yield (_t(rng, 2, 12), 1, (5, 3)), {}, RuntimeError, "unflatten|product|size"
+    yield (_t(rng, 2, 12), 1, (5, 3)), {}, RuntimeError, "must multiply to dim"
 
 
 def _err_tensordot(rng):
-    yield (_t(rng, 3, 4), _t(rng, 5, 6)), {"dims": 1}, RuntimeError, "contract|shape|dim"
+    yield (_t(rng, 3, 4), _t(rng, 5, 6)), {"dims": 1}, RuntimeError, "element count mismatch"
 
 
 def _err_conv_groups(rng):
-    yield (_t(rng, 1, 4, 8, 8), _t(rng, 4, 4, 3, 3)), {"groups": 3}, RuntimeError, "group|divis|channel"
+    yield (_t(rng, 1, 4, 8, 8), _t(rng, 4, 4, 3, 3)), {"groups": 3}, RuntimeError, "input channels"
 
 
 def _err_avg_pool(rng):
-    yield (_t(rng, 1, 2, 8, 8), 0), {}, RuntimeError, "kernel|positive"
+    yield (_t(rng, 1, 2, 8, 8), 0), {}, RuntimeError, "kernel sizes must be positive"
 
 
 def _err_sdpa(rng):
-    yield (_t(rng, 2, 4, 8, 16), _t(rng, 2, 4, 8, 32), _t(rng, 2, 4, 8, 32)), {}, RuntimeError, "head|dim|shape"
+    yield (_t(rng, 2, 4, 8, 16), _t(rng, 2, 4, 8, 32), _t(rng, 2, 4, 8, 32)), {}, RuntimeError, "must match k head dim"
 
 
 def _err_interpolate(rng):
@@ -1527,55 +1527,55 @@ def _err_interpolate(rng):
 
 
 def _err_norm_ord(rng):
-    yield (_t(rng, 3, 4),), {"p": "bad"}, RuntimeError, "ord|p |norm"
+    yield (_t(rng, 3, 4),), {"p": "bad"}, RuntimeError, "ord/p must be a number"
 
 
 def _err_tril_1d(rng):
-    yield (_t(rng, 5),), {}, RuntimeError, "2|dim|matrix"
+    yield (_t(rng, 5),), {}, RuntimeError, "at least 2 dims"
 
 
 def _err_repeat_interleave(rng):
-    yield (_t(rng, 3), -2), {}, RuntimeError, "negative|positive|repeat"
+    yield (_t(rng, 3), -2), {}, RuntimeError, "must be non-negative"
 
 
 def _err_one_hot(rng):
-    yield (jnp.zeros((3,), jnp.int32), -5), {}, RuntimeError, "class|positive|negative"
+    yield (jnp.zeros((3,), jnp.int32), -5), {}, RuntimeError, "num_classes must be positive"
 
 
 def _err_clamp(rng):
-    yield (_t(rng, 3),), {}, RuntimeError, "min|max|none"
+    yield (_t(rng, 3),), {}, RuntimeError, "at least one of min or max"
 
 
 def _err_broadcast_to(rng):
-    yield (_t(rng, 3, 4), (3, 5)), {}, RuntimeError, "broadcast|shape"
+    yield (_t(rng, 3, 4), (3, 5)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_batch_norm(rng):
-    yield (_t(rng, 2, 3, 4), _t(rng, 5), _t(rng, 5)), {"training": False}, RuntimeError, "running|channel|shape"
+    yield (_t(rng, 2, 3, 4), _t(rng, 5), _t(rng, 5)), {"training": False}, RuntimeError, "cannot broadcast"
 
 
 def _err_mse(rng):
-    yield (_t(rng, 2, 3), _t(rng, 4, 5)), {}, RuntimeError, "broadcast|shape"
+    yield (_t(rng, 2, 3), _t(rng, 4, 5)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_dot(rng):
-    yield (_t(rng, 3), _t(rng, 4)), {}, RuntimeError, "1D|size|shape"
+    yield (_t(rng, 3), _t(rng, 4)), {}, RuntimeError, "must have the same size"
 
 
 def _err_outer(rng):
-    yield (_t(rng, 2, 2), _t(rng, 3)), {}, RuntimeError, "1D|vector|dim"
+    yield (_t(rng, 2, 2), _t(rng, 3)), {}, RuntimeError, "expects 1D vectors"
 
 
 def _err_diag_embed(rng):
-    yield (_t(rng, 3, 4),), {"dim1": 1, "dim2": 1}, RuntimeError, "dim|distinct|same"
+    yield (_t(rng, 3, 4),), {"dim1": 1, "dim2": 1}, RuntimeError, "must be distinct"
 
 
 def _err_roll(rng):
-    yield (_t(rng, 3, 4), (1, 2), (0,)), {}, RuntimeError, "shift|dim|length"
+    yield (_t(rng, 3, 4), (1, 2), (0,)), {}, RuntimeError, "must have the same length"
 
 
 def _err_fold(rng):
-    yield (_t(rng, 1, 8, 4), (4, 4), (3, 3)), {}, RuntimeError, "fold|block|size"
+    yield (_t(rng, 1, 8, 4), (4, 4), (3, 3)), {}, RuntimeError, "kernel block size"
 
 
 ERROR_OPINFOS += [
@@ -1634,11 +1634,11 @@ ERROR_OPINFOS += [
 
 
 def _err_index_add(rng):
-    yield (_t(rng, 5, 4), 7, jnp.asarray([0, 1], jnp.int32), _t(rng, 2, 4)), {}, IndexError, "dim|range"
+    yield (_t(rng, 5, 4), 7, jnp.asarray([0, 1], jnp.int32), _t(rng, 2, 4)), {}, IndexError, "out of range for rank"
 
 
 def _err_scatter_add(rng):
-    yield (_t(rng, 4, 10), 9, jnp.zeros((4, 3), jnp.int32), _t(rng, 4, 3)), {}, IndexError, "dim|range"
+    yield (_t(rng, 4, 10), 9, jnp.zeros((4, 3), jnp.int32), _t(rng, 4, 3)), {}, IndexError, "out of range for rank"
 
 
 def _err_conv1d(rng):
@@ -1646,23 +1646,23 @@ def _err_conv1d(rng):
 
 
 def _err_vector_norm(rng):
-    yield (_t(rng, 3, 4),), {"ord": "bad"}, RuntimeError, "ord|norm|p "
+    yield (_t(rng, 3, 4),), {"ord": "bad"}, RuntimeError, "ord/p must be a number"
 
 
 def _err_hsplit(rng):
-    yield (_t(rng, 3, 7), 2), {}, RuntimeError, "divis|split|section"
+    yield (_t(rng, 3, 7), 2), {}, RuntimeError, "split"
 
 
 def _err_movedim(rng):
-    yield (_t(rng, 2, 3, 4), 0, 5), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3, 4), 0, 5), {}, IndexError, "out of range for rank"
 
 
 def _err_prod(rng):
-    yield (_t(rng, 2, 3),), {"dim": 4}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3),), {"dim": 4}, IndexError, "out of range for rank"
 
 
 def _err_lerp(rng):
-    yield (_t(rng, 3, 4), _t(rng, 2, 5), 0.3), {}, RuntimeError, "broadcast|shape"
+    yield (_t(rng, 3, 4), _t(rng, 2, 5), 0.3), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_atleast(rng):
@@ -1671,27 +1671,27 @@ def _err_atleast(rng):
 
 
 def _err_std(rng):
-    yield (_t(rng, 2, 3),), {"dim": 5}, IndexError, "dim|range"
+    yield (_t(rng, 2, 3),), {"dim": 5}, IndexError, "out of range for rank"
 
 
 def _err_tensor_split(rng):
-    yield (_t(rng, 2, 6), 3, 4), {}, IndexError, "dim|range"
+    yield (_t(rng, 2, 6), 3, 4), {}, IndexError, "out of range for rank"
 
 
 def _err_swiglu(rng):
-    yield (_t(rng, 3, 8), _t(rng, 3, 6)), {}, RuntimeError, "broadcast|shape"
+    yield (_t(rng, 3, 8), _t(rng, 3, 6)), {}, RuntimeError, "cannot broadcast"
 
 
 def _err_addbmm(rng):
-    yield (_t(rng, 3, 5), _t(rng, 2, 3, 4), _t(rng, 2, 5, 5)), {}, RuntimeError, "matmul|shape|contract"
+    yield (_t(rng, 3, 5), _t(rng, 2, 3, 4), _t(rng, 2, 5, 5)), {}, RuntimeError, "matmul:"
 
 
 def _err_multi_dot(rng):
-    yield ([_t(rng, 3, 4), _t(rng, 5, 6)],), {}, RuntimeError, "matmul|shape|contract"
+    yield ([_t(rng, 3, 4), _t(rng, 5, 6)],), {}, RuntimeError, "matmul:"
 
 
 def _err_pixel_unshuffle(rng):
-    yield (_t(rng, 1, 2, 5, 6), 2), {}, RuntimeError, "divis|factor|shuffle"
+    yield (_t(rng, 1, 2, 5, 6), 2), {}, RuntimeError, "must be divisible by downscale_factor"
 
 
 ERROR_OPINFOS += [

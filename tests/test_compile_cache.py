@@ -58,3 +58,63 @@ def test_cache_defaults_off_on_cpu_backend():
         pytest.skip("only meaningful under a cpu backend env")
     r = _run({})
     assert r["dir"] is None
+
+
+# -- AOT executable cache (utils/aot_cache.py) --
+
+_AOT_SNIPPET = """
+import time, json
+import jax.numpy as jnp, numpy as np
+import thunder_tpu as tt
+from thunder_tpu import optim
+from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+from thunder_tpu.training import TrainStep
+cfg = Config.from_name("tiny")
+rng = np.random.RandomState(0)
+idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+step = TrainStep(GPTForCausalLM(cfg), optim.AdamW(lr=1e-4))
+losses = [float(step(idx, tgt)) for _ in range(3)]
+from thunder_tpu.training import _CompiledWithFallback
+print(json.dumps({"losses": losses,
+                  "aot": isinstance(step._jitted, _CompiledWithFallback)}))
+"""
+
+
+def test_aot_cache_cross_process_parity(tmp_path):
+    """Warm process deserializes the whole-step executable and produces
+    bit-identical losses (the warm-compile path must not change numerics)."""
+    aot = str(tmp_path / "aot")
+    env = {"TT_AOT_CACHE_DIR": aot}
+    out1 = subprocess.run([sys.executable, "-c", _AOT_SNIPPET],
+                          env={**os.environ, "PYTHONPATH": REPO, **env},
+                          capture_output=True, text=True, timeout=600)
+    assert out1.returncode == 0, out1.stderr[-2000:]
+    r1 = json.loads(out1.stdout.strip().splitlines()[-1])
+    assert r1["aot"], "cold process did not engage the AOT save path"
+    assert os.listdir(aot), "cold process wrote no AOT entries"
+    out2 = subprocess.run([sys.executable, "-c", _AOT_SNIPPET],
+                          env={**os.environ, "PYTHONPATH": REPO, **env},
+                          capture_output=True, text=True, timeout=600)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    r2 = json.loads(out2.stdout.strip().splitlines()[-1])
+    assert r2["losses"] == r1["losses"], "warm AOT start changed numerics"
+
+
+def test_aot_cache_stale_source_invalidates(tmp_path, monkeypatch):
+    from thunder_tpu.utils import aot_cache
+
+    monkeypatch.setattr(aot_cache, "_SRC_DIGEST", "digest-a")
+    k1 = aot_cache.step_key(inputs=(1, 2), extra="x")
+    monkeypatch.setattr(aot_cache, "_SRC_DIGEST", "digest-b")
+    k2 = aot_cache.step_key(inputs=(1, 2), extra="x")
+    assert k1 != k2
+
+
+def test_aot_cache_default_off_on_cpu(monkeypatch):
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        pytest.skip("only meaningful under a cpu backend env")
+    from thunder_tpu.utils import aot_cache
+
+    monkeypatch.delenv("TT_AOT_CACHE_DIR", raising=False)
+    assert not aot_cache.enabled()

@@ -16,6 +16,11 @@ from .codeutils import ContextInterner, prettyprint, flat_proxies
 from .proxies import Proxy, variableify
 from .trace import get_tracectx
 
+# stack of active in-forward autocast policies (transforms/autocast.py
+# autocast_ctx); entries are callables (sym, args, kwargs) -> (args, kwargs),
+# or None for an enabled=False region
+_autocast_stack: list = []
+
 
 class OpTags:
     """Reference thunder/core/prims.py:287 OpTags."""
@@ -75,6 +80,14 @@ class Symbol(SymbolInterface):
             from ..executors import jaxex
 
             return jaxex.eager_execute(self, *args, **kwargs)
+
+        if _autocast_stack:
+            # in-forward autocast region (transforms/autocast.py autocast_ctx):
+            # the active policy casts matmul-class inputs at bind time, so the
+            # casts are ordinary trace bsyms and survive autodiff/retracing
+            pol = _autocast_stack[-1]
+            if pol is not None:
+                args, kwargs = pol(self, args, kwargs)
 
         if self.is_prim:
             out = self.meta(*args, **kwargs)

@@ -43,6 +43,11 @@ DEFAULT_BLOCK_K = int(os.environ.get("TT_FLASH_BLOCK_K", "1024"))
 # k-block cap for the GQA streaming dkv backward (swept separately: its
 # working set scales with block_k x block_q tiles plus the group's q/do)
 _GQA_BLOCK_K = int(os.environ.get("TT_FLASH_GQA_BLOCK_K", "512"))
+# single-pass fused backward blocks (swept on v5e across llama-350m/llama-1b/
+# nanogpt shapes: 512/512 wins everywhere — 4.11/2.75/2.80 ms fwd+bwd vs
+# 4.52/3.24/3.42 two-pass; 1024-row q blocks blow the 16 MB VMEM limit)
+_FUSED_BLOCK_Q = int(os.environ.get("TT_FLASH_FUSED_BLOCK_Q", "512"))
+_FUSED_BLOCK_K = int(os.environ.get("TT_FLASH_FUSED_BLOCK_K", "512"))
 
 
 def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int, *extra):
@@ -300,6 +305,121 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_re
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _fused_bwd_tile(q, do, lse2, delta, k_blk, v_blk, sl, k_pos_t, q_pos_t,
+                    causal, scale, dk_scr, dv_scr, dq_acc):
+    """One (i, j) tile of the single-pass backward, shared by the plain and
+    rope fused kernels (the _dkv_tile role for the fused design): computes
+    s/p ONCE, accumulates dk/dv into the VMEM scratch slice and returns the
+    updated dq accumulator. Transposed orientation (rows = k positions)."""
+    s_t = jax.lax.dot_general(k_blk, q, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32) * (scale * LOG2E)
+    if causal:
+        s_t = jnp.where(k_pos_t <= q_pos_t, s_t, NEG_INF)
+    p_t = jnp.exp2(s_t - lse2[None, :])
+    dv_c = jax.lax.dot_general(p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    dp_t = jax.lax.dot_general(v_blk, do, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+    dk_c = jax.lax.dot_general(ds_t, q, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    dk_scr[sl, :] += dk_c
+    dv_scr[sl, :] += dv_c
+    return dq_acc + jax.lax.dot_general(ds_t, k_blk, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                            block_k: int, causal: bool, scale: float,
+                            g: int, n_i: int):
+    """Single-pass backward (PROFILE_350M.md lever 2): grid (B, Hkv, T//block_q)
+    with k/v full-T resident; each program computes s/p ONCE per (i, j) tile
+    and emits BOTH its dq tile (written per program) and the dk/dv
+    contributions (f32 VMEM scratch accumulated across the i axis, written at
+    the last i) — vs the two-pass design this halves the backward exp and
+    QK^T work (5 dots + 1 exp per tile instead of 7 + 2)."""
+    Tk, D = k_ref.shape
+    block_q = q_ref.shape[1]
+    ii = pl.program_id(2)
+
+    @pl.when(ii == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    n_j = Tk // block_k
+    if causal:
+        n_j = jnp.minimum(n_j, ((ii + 1) * block_q + block_k - 1) // block_k)
+
+    for h in range(g):  # static unroll over the q-head group (1 for MHA)
+        q = q_ref[h]
+        do = do_ref[h]
+        lse2 = lse_ref[h][:, 0] * LOG2E
+        delta = delta_ref[h][:, 0]
+        q_pos_t = ii * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+
+        def body(j, dq_acc):
+            sl = pl.ds(j * block_k, block_k)
+            k_pos_t = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+            return _fused_bwd_tile(q, do, lse2, delta, k_ref[sl, :], v_ref[sl, :],
+                                   sl, k_pos_t, q_pos_t, causal, scale,
+                                   dk_scr, dv_scr, dq_acc)
+
+        dq = jax.lax.fori_loop(0, n_j, body, jnp.zeros((block_q, D), jnp.float32))
+        dq_ref[h] = dq.astype(dq_ref.dtype)
+
+    @pl.when(ii == n_i - 1)
+    def _write():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fused_bwd_enabled() -> bool:
+    return pltpu is not None and os.environ.get("TT_FLASH_TWO_PASS_BWD", "0") != "1"
+
+
+def _flash_backward_fused(q, k, v, do, lse4, delta4, *, causal, scale,
+                          block_q, block_k):
+    B, H, T, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    g = H // Hkv
+    block_q = math.gcd(min(block_q, _FUSED_BLOCK_Q), T)
+    block_k = math.gcd(min(block_k, _FUSED_BLOCK_K), Tk)
+    qg = q.reshape(B, Hkv, g, T, D)
+    dog = do.reshape(B, Hkv, g, T, D)
+    lseg = lse4.reshape(B, Hkv, g, T, 1)
+    deltag = delta4.reshape(B, Hkv, g, T, 1)
+    n_i = T // block_q
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, block_k=block_k,
+                          causal=causal, scale=scale, g=g, n_i=n_i),
+        grid=(B, Hkv, n_i),
+        in_specs=[
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, i: (b, hk, 0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, hk, i: (b, hk, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, g, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((Tk, D), jnp.float32),
+                        pltpu.VMEM((Tk, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qg, k, v, dog, lseg, deltag)
+    return dq.reshape(B, H, T, D), dk, dv
+
+
 def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=None,
                              block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -323,6 +443,10 @@ def flash_attention_backward(q, k, v, o, lse, do, *, causal: bool = True, scale=
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # (B,H,T)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
+
+    if _fused_bwd_enabled():
+        return _flash_backward_fused(q, k, v, do, lse4, delta4, causal=causal,
+                                     scale=scale, block_q=block_q, block_k=block_k)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -632,6 +756,97 @@ def _flash_rope_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _flash_rope_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                                 cq_ref, sq_ref, ck_ref, sk_ref,
+                                 dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                                 block_k: int, causal: bool, scale: float,
+                                 g: int, n_i: int):
+    """Single-pass rope backward (see _flash_bwd_fused_kernel): rope applied
+    in-kernel on q/k loads, rope VJP on the dq carry at write and on the dk
+    scratch at the final i."""
+    Tk, D = k_ref.shape
+    block_q = q_ref.shape[1]
+    ii = pl.program_id(2)
+
+    @pl.when(ii == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    n_j = Tk // block_k
+    if causal:
+        n_j = jnp.minimum(n_j, ((ii + 1) * block_q + block_k - 1) // block_k)
+
+    for h in range(g):  # static unroll over the q-head group (1 for MHA)
+        q = _rope_block(q_ref[h].astype(jnp.float32), cq_ref[:], sq_ref[:]).astype(q_ref.dtype)
+        do = do_ref[h]
+        lse2 = lse_ref[h][:, 0] * LOG2E
+        delta = delta_ref[h][:, 0]
+        q_pos_t = ii * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+
+        def body(j, dq_acc):
+            sl = pl.ds(j * block_k, block_k)
+            k_blk = _rope_block(k_ref[sl, :].astype(jnp.float32),
+                                ck_ref[sl, :], sk_ref[sl, :]).astype(k_ref.dtype)
+            k_pos_t = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+            return _fused_bwd_tile(q, do, lse2, delta, k_blk, v_ref[sl, :],
+                                   sl, k_pos_t, q_pos_t, causal, scale,
+                                   dk_scr, dv_scr, dq_acc)
+
+        dq = jax.lax.fori_loop(0, n_j, body, jnp.zeros((block_q, D), jnp.float32))
+        dq_ref[h] = _rope_vjp_block(dq, cq_ref[:], sq_ref[:]).astype(dq_ref.dtype)
+
+    @pl.when(ii == n_i - 1)
+    def _write():
+        dk_ref[:] = _rope_vjp_block(dk_scr[:], ck_ref[:], sk_ref[:]).astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_rope_backward_fused(q, k, v, do, lse4, delta4, cos, sin, *, causal,
+                               scale, block_q, block_k):
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv
+    block_q = math.gcd(min(block_q, _FUSED_BLOCK_Q), T)
+    block_k = math.gcd(min(block_k, _FUSED_BLOCK_K), T)
+    qg = q.reshape(B, Hkv, g, T, D)
+    dog = do.reshape(B, Hkv, g, T, D)
+    lseg = lse4.reshape(B, Hkv, g, T, 1)
+    deltag = delta4.reshape(B, Hkv, g, T, 1)
+    n_i = T // block_q
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_rope_bwd_fused_kernel, block_k=block_k,
+                          causal=causal, scale=scale, g=g, n_i=n_i),
+        grid=(B, Hkv, n_i),
+        in_specs=[
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, g, block_q, 1), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, hk, i: (i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, hk, i: (i, 0)),
+            pl.BlockSpec((T, D), lambda b, hk, i: (0, 0)),
+            pl.BlockSpec((T, D), lambda b, hk, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, g, block_q, D), lambda b, hk, i: (b, hk, 0, i, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, hk, i: (b, hk, 0, 0)),
+            pl.BlockSpec((None, None, T, D), lambda b, hk, i: (b, hk, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, g, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, T, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
+                        pltpu.VMEM((T, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qg, k, v, dog, lseg, deltag, cos, sin, cos, sin)
+    return dq.reshape(B, H, T, D), dk, dv
+
+
 def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool = True,
                                   scale=None, block_q: int = DEFAULT_BLOCK_Q,
                                   block_k: int = DEFAULT_BLOCK_K):
@@ -655,6 +870,11 @@ def flash_rope_attention_backward(q, k, v, o, lse, cos, sin, do, *, causal: bool
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
+
+    if _fused_bwd_enabled():
+        return _flash_rope_backward_fused(q, k, v, do, lse4, delta4, cos, sin,
+                                          causal=causal, scale=scale,
+                                          block_q=block_q, block_k=block_k)
 
     dq = pl.pallas_call(
         functools.partial(_flash_rope_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -1131,7 +1351,9 @@ def _int8_linear_supported(x, qweight, scale, bias=None):
     # whole-M block (no M grid): claim the serving/decode regime; huge-M
     # prefill/training shapes stay on the XLA path (compute-bound there)
     return (
-        str(getattr(qweight, "dtype", "")) == "int8"
+        # exact dtype name (proxy dtypes print as "dtypes.int8"): uint8 must
+        # NOT claim the kernel — it would be reinterpreted as signed
+        str(getattr(qweight, "dtype", "")).rpartition(".")[2] == "int8"
         and x.shape[-1] == K
         and K % 128 == 0 and K <= 8192
         and N % 128 == 0

@@ -229,8 +229,81 @@ def _register_builtin_lookasides() -> None:
                 "data-dependent; use ltorch.sort on a stacked tensor")
         return builtins.sorted(x, **kwargs)
 
+    def _anyall(name, reduce_name, x):
+        # builtins.any/all iterate and bool() each element: over a tensor
+        # that is per-element data-dependent control flow. A 1-D tensor has
+        # a sound traced equivalent (the reduction); everything else raises
+        # with the torch-matching guidance.
+        if isinstance(x, TensorProxy):
+            if x.ndim == 0:
+                raise TypeError(f"builtins.{name} of a 0-d tensor (not iterable, as in torch)")
+            if x.ndim == 1:
+                return getattr(_lt(), reduce_name)(x)
+            raise InterpreterError(
+                f"builtins.{name} over a {x.ndim}-D tensor bool()s whole rows "
+                f"(data-dependent); use ltorch.{reduce_name} for a reduction")
+        if not isinstance(x, (list, tuple)):
+            # generators are the common form (any(t > 0 for t in xs)):
+            # materialize so tensor elements are caught, not silently
+            # bool()'d truthy by builtins.any
+            x = list(x)
+        if _contains_tensor(x):
+            raise InterpreterError(
+                f"builtins.{name} over a sequence containing tensors is "
+                f"data-dependent; reduce with ltorch.{reduce_name}")
+        return getattr(builtins, name)(x)
+
+    @register_lookaside(builtins.any)
+    def _any_la(x):
+        return _anyall("any", "any", x)
+
+    @register_lookaside(builtins.all)
+    def _all_la(x):
+        return _anyall("all", "all", x)
+
+    @register_lookaside(builtins.sum)
+    def _sum_la(x, start=0):
+        if isinstance(x, TensorProxy):
+            if x.ndim == 0:
+                raise TypeError("builtins.sum of a 0-d tensor (not iterable, as in torch)")
+            # iterating would trace one add per element; the reduction over
+            # the leading dim is the identical result in one op
+            out = _lt().sum(x, 0)
+            return out if start == 0 else _lt().add(out, start)
+        if isinstance(x, (list, tuple)) and builtins.any(isinstance(e, TensorProxy) for e in x):
+            out = start
+            for e in x:
+                out = _lt().add(out, e) if isinstance(out, TensorProxy) or isinstance(e, TensorProxy) else out + e
+            return out
+        return builtins.sum(x, start)
+
+    @register_lookaside(builtins.isinstance)
+    def _isinstance_la(obj, classinfo):
+        # duck-typing escape hatch: user code checking isinstance(x, jax.Array)
+        # (or np.ndarray) must see True for the proxy standing in for it
+        if isinstance(obj, TensorProxy):
+            import jax
+            import numpy as np
+
+            infos = classinfo if isinstance(classinfo, tuple) else (classinfo,)
+            if builtins.any(c in (jax.Array, np.ndarray) for c in infos if isinstance(c, type)):
+                return True
+        return builtins.isinstance(obj, classinfo)
+
+
+def _register_framework_lookasides() -> None:
+    """Framework context managers run natively (their bodies only mutate
+    host-side trace state; interpreting them would walk framework imports) —
+    the autocast __enter__/__exit__ lookaside role of reference
+    jit_ext.py:411-1080."""
+    from ..transforms.autocast import autocast_ctx
+
+    register_lookaside(autocast_ctx.__enter__)(autocast_ctx.__enter__)
+    register_lookaside(autocast_ctx.__exit__)(autocast_ctx.__exit__)
+
 
 _register_builtin_lookasides()
+_register_framework_lookasides()
 
 
 # modules whose functions run natively (opaque) rather than interpreted

@@ -823,6 +823,14 @@ def dispatch(func, args, kwargs):
         # of the in-place name (e.g. masked_fill_) resolve the impl but must
         # go through the rebind too, or statement-form calls drop the effect.
         base = name[:-1]
+        if base in ("exponential", "uniform", "normal", "cauchy", "geometric",
+                    "log_normal", "random", "bernoulli"):
+            # stateful-RNG samplers: the torch call carries no key, and the
+            # key-accepting ltorch variants must not silently fix the seed
+            raise NotImplementedError(
+                f"in-place RNG sampler Tensor.{name}() draws from torch's "
+                f"global generator; use the key-accepting ltorch.{base}(key=...) "
+                f"or sample outside the compiled region")
         fimpl = (impl
                  or _EXPLICIT.get(getattr(torch, base, None))
                  or _EXPLICIT.get(getattr(torch.Tensor, base, None))

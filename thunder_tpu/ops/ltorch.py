@@ -2922,3 +2922,145 @@ def tensor(seq, *, dtype=None, device=None):
         out = clang.tensor_from_sequence(seq, dtype=dtype, device=device)
         return clang.squeeze(out, 0)
     return clang.tensor_from_sequence(seq, dtype=dtype, device=device)
+
+
+# ---------------------------------------------------------------------------
+# reference @torchsymbol parity stragglers (LTORCH_COVERAGE.md maps every
+# reference name; these close the genuinely-missing tail — reference
+# thunder/torch/__init__.py:153)
+# ---------------------------------------------------------------------------
+
+
+@torchsymbol(name="view", id="torch.Tensor.view")
+def view(a, *shape):
+    """torch.Tensor.view — under XLA every array is logically contiguous and
+    reshape is layout-free, so view IS reshape (also registered as the
+    ``view`` tensor method via ``reshape``)."""
+    return reshape(a, *shape)
+
+
+@torchsymbol(name="item", method_names=("item",), id="torch.Tensor.item")
+def item(a):
+    """Tensor.item() -> NumberProxy (a DEVICE_SYNC_OP prim: forces a host
+    read at execution, never fuses)."""
+    return prims.item(a)
+
+
+@torchsymbol(name="exponential", method_names=("exponential",))
+def exponential(a, lambd=1.0, *, key=None):
+    """Key-accepting exponential sampler (torch's Tensor.exponential_ is a
+    stateful-RNG op; the stateless variant follows the dropout/bernoulli
+    key= convention): inverse-CDF -log(1-u)/lambd."""
+    check(key is not None, lambda: "exponential requires an rng key (key=)")
+    check(pyval(lambd) > 0, lambda: f"exponential rate must be positive, got {lambd}")
+    u = prims.uniform(a.shape, 0.0, 1.0, key=key, device=a.device, dtype=dtypes.float32)
+    out = clang.true_divide(prims.neg(prims.log1p(prims.neg(u))), lambd)
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(name="scaled_mm", id="torch._scaled_mm")
+def scaled_mm(a, b, scale_a, scale_b, bias=None, out_dtype=None):
+    """torch._scaled_mm: fp8 matmul with per-tensor dequant scales. The fp8
+    executor claims this pattern when generated by the fp8 transform; this
+    symbol is the direct user entry."""
+    af = clang.mul(clang.maybe_convert_to_dtype(a, dtypes.float32), scale_a)
+    bf = clang.mul(clang.maybe_convert_to_dtype(b, dtypes.float32), scale_b)
+    out = prims.matmul(af, bf)
+    if bias is not None:
+        out = clang.add(out, bias)
+    if out_dtype is not None:
+        out = clang.maybe_convert_to_dtype(out, dtypes.to_dtype(out_dtype))
+    return out
+
+
+@torchsymbol(name="torch_type", method_names=("type",), id="torch.Tensor.type")
+def torch_type(a, dtype=None):
+    """Tensor.type(dtype): dtype cast. The zero-arg form returns a host
+    string (metadata, resolved by the interop frontend, not traced)."""
+    check(dtype is not None,
+          lambda: "type() without arguments is host metadata; read .dtype instead")
+    return clang.maybe_convert_to_dtype(a, dtypes.to_dtype(dtype))
+
+
+@torchsymbol(name="log_softmax_backward", id="torch.ops.aten._log_softmax_backward_data")
+def log_softmax_backward(g, output, dim, input_dtype=None):
+    """aten::_log_softmax_backward_data: dx = g - exp(out) * sum(g, dim)."""
+    soft = prims.exp(clang.maybe_convert_to_dtype(output, dtypes.float32))
+    gf = clang.maybe_convert_to_dtype(g, dtypes.float32)
+    out = clang.sub(gf, clang.mul(soft, clang.sum_(gf, pyval(dim), keepdim=True)))
+    return clang.maybe_convert_to_dtype(
+        out, dtypes.to_dtype(input_dtype) if input_dtype is not None else g.dtype)
+
+
+@torchsymbol(name="embedding_backward", id="torch.ops.aten.embedding_backward")
+def embedding_backward(g, indices, num_weights, padding_idx=-1,
+                       scale_grad_by_freq=False, sparse=False):
+    """aten::embedding_backward: scatter-add of output grads into a
+    (num_weights, D) zero table (dense; sparse grads have no XLA analog)."""
+    check(not pyval(scale_grad_by_freq),
+          lambda: "embedding_backward: scale_grad_by_freq is a host-side "
+                  "frequency count; run it outside the traced region")
+    D = g.shape[-1]
+    n = 1
+    for d in indices.shape:
+        n *= pyval(d)
+    gf = clang.reshape(g, (n, D))
+    idx = clang.reshape(indices, (n,))
+    pad = pyval(padding_idx)
+    if pad >= 0:
+        keep = clang.ne(idx, pad)
+        gf = clang.mul(gf, clang.unsqueeze(clang.maybe_convert_to_dtype(keep, gf.dtype), 1))
+    table = clang.full((pyval(num_weights), D), 0.0, dtype=gf.dtype, device=g.device)
+    return clang.index_add(table, idx, gf, 0)
+
+
+@torchsymbol(name="nll_loss_backward", id="torch.ops.aten.nll_loss_backward")
+def nll_loss_backward(g, log_probs, target, weight=None, reduction="mean",
+                      ignore_index=-100, total_weight=None):
+    """aten::nll_loss_backward: d nll / d log_probs is -w one_hot(target),
+    normalized per the reduction (mean divides by the valid-weight sum the
+    forward used, passed back as total_weight)."""
+    C = log_probs.shape[1]
+    valid = clang.ne(target, ignore_index)
+    safe_tgt = clang.where(valid, target, clang.full_like(target, 0))
+    oh = clang.maybe_convert_to_dtype(one_hot(safe_tgt, C), log_probs.dtype)
+    if weight is not None:
+        w = clang.take(weight, safe_tgt, 0)
+    else:
+        w = clang.maybe_convert_to_dtype(valid, log_probs.dtype)
+    wv = clang.mul(w, clang.maybe_convert_to_dtype(valid, log_probs.dtype))
+    grad = prims.neg(clang.mul(oh, clang.unsqueeze(wv, 1)))
+    if reduction == "none":
+        return clang.mul(grad, clang.unsqueeze(g, 1))
+    if reduction == "sum":
+        return clang.mul(grad, g)
+    denom = total_weight if total_weight is not None else clang.sum_(wv)
+    return clang.true_divide(clang.mul(grad, g), denom)
+
+
+@torchsymbol(name="adaptive_avg_pool2d_backward", id="torch.ops.aten._adaptive_avg_pool2d_backward")
+def adaptive_avg_pool2d_backward(g, a):
+    """aten::_adaptive_avg_pool2d_backward for the divisible-window case the
+    forward supports: each output grad spreads evenly over its kh x kw
+    window."""
+    H, W = a.shape[-2], a.shape[-1]
+    oh, ow = g.shape[-2], g.shape[-1]
+    check(H % oh == 0 and W % ow == 0,
+          lambda: f"adaptive_avg_pool2d_backward: {H}x{W} not divisible by {oh}x{ow}")
+    kh, kw = H // oh, W // ow
+    lead = tuple(g.shape[:-2])
+    scaled = clang.true_divide(g, float(kh * kw))
+    expanded = clang.reshape(scaled, lead + (oh, 1, ow, 1))
+    nd = len(lead)
+    bcast = prims.broadcast_in_dim(
+        expanded, lead + (oh, kh, ow, kw),
+        tuple(range(nd)) + (nd, nd + 1, nd + 2, nd + 3))
+    return clang.reshape(bcast, lead + (H, W))
+
+
+@torchsymbol(name="copy", method_names=("copy",))
+def copy(a, b):
+    """Out-of-place base of Tensor.copy_ (the interop frontend's generic
+    in-place handling strips the underscore, runs this, and rebinds the
+    receiver): b broadcast to a's shape and cast to a's dtype."""
+    return clang.maybe_convert_to_dtype(clang.expand(b, a.shape), a.dtype)

@@ -67,6 +67,55 @@ _register_grad()
 
 
 # ---------------------------------------------------------------------------
+# activation sharding: user annotation + plan-driven constraint pass
+# ---------------------------------------------------------------------------
+
+
+def annotate(x, spec: Sequence[Optional[str]]):
+    """User-facing activation annotation: `annotate(h, ("dp", None, "tp"))`
+    inside a forward pins h's layout on the GSPMD road (records the
+    shard_constraint prim; a no-op without a mesh context)."""
+    return shard_constraint(x, tuple(spec))
+
+
+from ..core.transform_common import Transform as _Transform
+
+
+class GspmdConstraintTransform(_Transform):
+    """Insert shard_constraint on the outputs of named symbols — the
+    plan-driven activation-sharding pass (DistPlan.activation_specs).
+
+    specs: {symbol_id: partition-spec tuple}, e.g.
+    {"torch.nn.functional.linear": (None, None, "tp")} constrains every
+    linear output. Runs pre-autodiff so the backward inherits the layout
+    through shard_constraint's vjp."""
+
+    def __init__(self, specs: dict):
+        self.specs = dict(specs)
+
+    def transform_traces_pre_autodiff(self, prologue_trc, computation_trc, *,
+                                      compile_data=None):
+        from ..core.trace_interpreter import TraceSubstitutionProcessor
+
+        specs = self.specs
+
+        def visitor(bsym, args, kwargs):
+            spec = specs.get(bsym.sym.id)
+            if spec is None:
+                return None
+            out = bsym.sym(*args, **kwargs)
+            # constrain only rank-matching outputs: a PartitionSpec longer or
+            # shorter than the rank raises inside with_sharding_constraint
+            if isinstance(out, TensorProxy) and out.ndim == len(spec):
+                return shard_constraint(out, tuple(spec))
+            return out
+
+        new_trc = TraceSubstitutionProcessor(computation_trc, visitor)()
+        new_trc.set_provenance(f"GSPMD activation constraints ({len(specs)} rules)")
+        return prologue_trc, new_trc
+
+
+# ---------------------------------------------------------------------------
 # GSPMD training step
 # ---------------------------------------------------------------------------
 
@@ -82,6 +131,10 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
     if getattr(step.tmodule, "_dist_plan", None) is not None:
         raise ValueError("gspmd_step and the explicit ddp()/fsdp() road are mutually "
                          "exclusive: pass the plan here, don't install it on the module")
+    if getattr(plan, "activation_specs", None):
+        # plan-driven activation layout: constrain matching symbol outputs
+        step.tmodule._cfn._transforms = tuple(step.tmodule._cfn._transforms) + (
+            GspmdConstraintTransform(plan.activation_specs),)
     # place parameter storage on its target sharding up front: the optimizer
     # state then inherits it (zeros_like), and the jitted step's in_shardings
     # match the actual arg placements

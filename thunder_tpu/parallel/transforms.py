@@ -58,6 +58,9 @@ class DistPlan:
     data_axes: tuple = ()  # axes the batch dim (dim 0) is sharded over
     tp_axis: Optional[str] = None
     seq_axes: tuple = ()  # axes the sequence dim (dim 1) is sharded over (context parallel)
+    # GSPMD road only: {symbol_id: partition-spec tuple} applied to matching
+    # symbol outputs via the shard_constraint prim (gspmd.GspmdConstraintTransform)
+    activation_specs: dict = field(default_factory=dict)
 
     def world_size(self, axis: str) -> int:
         return axis_size(self.mesh, axis)

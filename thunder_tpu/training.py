@@ -18,6 +18,57 @@ import jax.numpy as jnp
 from .nn.module import Module, ThunderModule
 
 
+def _stable_val(v, depth: int = 0) -> str:
+    """Deterministic string for a config value: simple types repr directly,
+    containers recurse, other objects render as type + their own stable
+    attrs (NEVER the default repr — it embeds addresses and would make
+    cache keys miss every process; silently dropping attrs is worse: two
+    semantically different configs would collide on the same key)."""
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return repr(v)
+    if isinstance(v, (tuple, list)):
+        return "[" + ",".join(_stable_val(e, depth + 1) for e in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(f"{k!r}:{_stable_val(val, depth + 1)}"
+                              for k, val in sorted(v.items(), key=lambda kv: str(kv[0]))) + "}"
+    if depth >= 3:
+        return f"<{type(v).__name__}>"
+    try:
+        attrs = vars(v)
+    except TypeError:
+        # dtype-like singletons print stably (e.g. "dtypes.bfloat16")
+        return f"{type(v).__name__}:{v!s}"
+    return (f"{type(v).__name__}(" +
+            ",".join(f"{k}={_stable_val(val, depth + 1)}"
+                     for k, val in sorted(attrs.items())) + ")")
+
+
+def _safe_repr(obj) -> str:
+    """Deterministic config repr for cache keys (see _stable_val)."""
+    return _stable_val(obj)
+
+
+class _CompiledWithFallback:
+    """A serialized-executable step that transparently falls back to the
+    retrace path (the jax.jit fn) if inputs stop matching the compiled
+    shapes — AOT warm starts must never change semantics."""
+
+    def __init__(self, compiled, jit_fn_factory):
+        self._compiled = compiled
+        self._factory = jit_fn_factory
+        self._jit_fn = None
+
+    def __call__(self, *args):
+        if self._compiled is not None:
+            try:
+                return self._compiled(*args)
+            except Exception:
+                self._compiled = None
+        if self._jit_fn is None:
+            self._jit_fn = self._factory()
+        return self._jit_fn(*args)
+
+
 class TrainStep:
     """step(*batch) -> loss; updates module parameters in place.
 
@@ -162,6 +213,61 @@ class TrainStep:
             self._jitted = _shard_mapped_step(raw_step_dist, plan, self.tmodule, self.opt_state,
                                               batch_args, batch_kwargs, donate)
 
+    # -- AOT executable cache (utils/aot_cache.py): warm process start
+    # deserializes the compiled whole-step program — no trace, no lowering,
+    # no XLA compile. Single-chip effect-free steps only (distributed plans
+    # go through shard_map; buffer-mutating steps carry module references).
+
+    def _aot_key(self, tparam_arrays, frozen_arrays, args, kwargs) -> str:
+        from .utils import aot_cache
+
+        extra = "|".join([
+            _safe_repr(self.optimizer),
+            repr(self._active_mode),
+            repr(self.donate),
+            "|".join(_safe_repr(t) for t in getattr(self.tmodule._cfn, "_transforms", ())),
+        ])
+        inputs = (tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+        return aot_cache.step_key(inputs=inputs, extra=extra)
+
+    def _try_aot(self, tparam_arrays, frozen_arrays, args, kwargs) -> bool:
+        from .utils import aot_cache
+
+        if not aot_cache.enabled() or getattr(self.tmodule, "_dist_plan", None) is not None:
+            return False
+        loaded = aot_cache.load(self._aot_key(tparam_arrays, frozen_arrays, args, kwargs))
+        if loaded is None:
+            return False
+        train_step = self
+
+        def rebuild():
+            train_step._jitted = None
+            train_step._build(args, kwargs)
+            return train_step._jitted
+
+        self._effect_keys = None
+        self._jitted = _CompiledWithFallback(loaded, rebuild)
+        return True
+
+    def _maybe_save_aot(self, tparam_arrays, frozen_arrays, args, kwargs) -> None:
+        from .utils import aot_cache
+
+        if not aot_cache.enabled() or getattr(self.tmodule, "_dist_plan", None) is not None:
+            return
+        jit_fn = self._jitted
+        try:
+            lowered = jit_fn.lower(tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            if getattr(self, "_effect_keys", None) is not None:
+                return  # buffer-mutation epilogues carry module refs: not cacheable
+            compiled = lowered.compile()
+            aot_cache.save(self._aot_key(tparam_arrays, frozen_arrays, args, kwargs), compiled)
+        except Exception:
+            return
+        # reuse the compiled program directly (the separate AOT lower/compile
+        # does not populate jax.jit's dispatch cache; without this the first
+        # call would trace the whole step a second time)
+        self._jitted = _CompiledWithFallback(compiled, lambda: jit_fn)
+
     def _split_params(self):
         params = self.tmodule.get_parameters()
         trainable = {k: p for k, p in params.items() if getattr(p, "requires_grad", True)}
@@ -183,7 +289,9 @@ class TrainStep:
         if self.opt_state is None:
             self.opt_state = self.optimizer.init(tparam_arrays)
         if self._jitted is None:
-            self._build(args, kwargs)
+            if not self._try_aot(tparam_arrays, frozen_arrays, args, kwargs):
+                self._build(args, kwargs)
+                self._maybe_save_aot(tparam_arrays, frozen_arrays, args, kwargs)
         self.last_batch = (args, kwargs)  # for memory_analysis/harnesses
         if self._grad_acc is not None:
             # final (syncing) step of a no_sync accumulation window: fold the
@@ -542,11 +650,20 @@ class TrainStep:
         """Compiled-program memory analysis of the last-built step."""
         if self._jitted is None or getattr(self, "last_batch", None) is None:
             return None
+        if isinstance(self._jitted, _CompiledWithFallback):
+            compiled = self._jitted._compiled
+            if compiled is not None:
+                return compiled.memory_analysis()
+            jitted = self._jitted._jit_fn
+            if jitted is None:
+                return None
+        else:
+            jitted = self._jitted
         trainable, frozen = self._split_params()
         tparams = {k: p.data for k, p in trainable.items()}
         fparams = {k: getattr(p, "data", p) for k, p in frozen.items()}
         args, kwargs = self.last_batch
-        return self._jitted.lower(tparams, fparams, self.opt_state, args, kwargs).compile().memory_analysis()
+        return jitted.lower(tparams, fparams, self.opt_state, args, kwargs).compile().memory_analysis()
 
 
 def _batch_pspec(plan, leaf):

@@ -85,3 +85,49 @@ class AutocastTransform(Transform):
 
 def autocast(dtype=dtypes.bfloat16) -> AutocastTransform:
     return AutocastTransform(dtype)
+
+
+class autocast_ctx:
+    """In-forward autocast region — the torch.amp.autocast analog
+    (reference jit_ext.py autocast __enter__/__exit__ lookasides,
+    thunder/core/jit_ext.py:411-1080):
+
+        def forward(self, x):
+            with autocast_ctx(dtypes.bfloat16):
+                h = ltorch.linear(x, self.w1)   # runs in bf16
+            return ltorch.linear(h, self.w2)    # stays f32
+
+    Applied at symbol-bind time (core/symbol.py hook), so the inserted casts
+    are ordinary trace bsyms: they survive autodiff, work under BOTH frontends
+    (direct tracing and the bytecode interpreter), and compose with nesting
+    and ``enabled=False`` exactly like torch's context manager."""
+
+    def __init__(self, dtype=dtypes.bfloat16, enabled: bool = True):
+        self.dtype = dtypes.to_dtype(dtype)
+        self.enabled = enabled
+        self._impl = AutocastTransform(self.dtype)
+
+    def _policy(self, sym, args, kwargs):
+        to = self.dtype
+        sid = sym.id
+        if sid == "thunder.rope_sdpa":
+            return (tuple(self._impl._cast(a, to) if i < 3 else a
+                          for i, a in enumerate(args)), kwargs)
+        if sid in _LOW_PRECISION_IDS:
+            return (tuple(self._impl._cast(a, to) for a in args),
+                    {k: self._impl._cast(v, to) for k, v in kwargs.items()})
+        if sid in _F32_IDS:
+            return tuple(self._impl._cast(a, dtypes.float32) for a in args), kwargs
+        return args, kwargs
+
+    def __enter__(self):
+        from ..core import symbol as _symbol
+
+        _symbol._autocast_stack.append(self._policy if self.enabled else None)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import symbol as _symbol
+
+        _symbol._autocast_stack.pop()
+        return False

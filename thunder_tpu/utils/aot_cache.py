@@ -1,0 +1,137 @@
+"""AOT whole-step executable cache: warm process start in seconds, not
+retrace time.
+
+The persistent XLA compilation cache (utils/compile_cache.py) only skips the
+XLA *backend* compile; a new process still pays thunder trace acquisition +
+transforms + jax retrace + StableHLO lowering (~40-70 s for the bench
+models). This layer serializes the COMPILED whole-step executable
+(`jax.experimental.serialize_executable`) keyed by everything that could
+change the program — package source digest, jax/jaxlib version, device kind,
+the step's input tree/shape/dtype spec, optimizer config — and on a warm
+start deserializes and runs it directly: no tracing, no lowering, no compile.
+
+BASELINE.json's secondary metric (compile_time_warm_s <= 10) is met here.
+
+Controlled by:
+  TT_AOT_CACHE_DIR — cache directory (default ~/.cache/thunder_tpu/aot)
+  TT_NO_AOT_CACHE=1 — disable
+Default-on only on non-CPU backends (CPU executables are machine-specific
+and compile in seconds anyway).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+
+_SRC_DIGEST: str | None = None
+
+
+def enabled() -> bool:
+    if os.environ.get("TT_NO_AOT_CACHE") == "1":
+        return False
+    if os.environ.get("TT_AOT_CACHE_DIR"):
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def cache_dir() -> str:
+    d = os.environ.get("TT_AOT_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "thunder_tpu", "aot")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def source_digest() -> str:
+    """sha256 over the package's .py sources — a code change invalidates
+    every cached executable (stale programs must never run silently)."""
+    global _SRC_DIGEST
+    if _SRC_DIGEST is not None:
+        return _SRC_DIGEST
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                h.update(p.encode())
+                with open(p, "rb") as f:
+                    h.update(f.read())
+    _SRC_DIGEST = h.hexdigest()
+    return _SRC_DIGEST
+
+
+def _spec(tree) -> str:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None:
+            parts.append(f"{shape}:{dtype}")
+        else:
+            parts.append(f"py:{type(leaf).__name__}:{leaf!r}")
+    return "|".join(parts)
+
+
+def step_key(*, inputs, extra: str = "") -> str:
+    """Cache key for a compiled step called with `inputs` (a pytree of
+    arrays/python scalars)."""
+    import jax
+
+    h = hashlib.sha256()
+    h.update(source_digest().encode())
+    h.update(jax.__version__.encode())
+    try:
+        h.update(jax.devices()[0].device_kind.encode())
+        h.update(str(len(jax.devices())).encode())
+    except Exception:
+        pass
+    h.update(_spec(inputs).encode())
+    h.update(extra.encode())
+    return h.hexdigest()
+
+
+def load(key: str):
+    """Deserialize a cached executable; None on miss or any failure."""
+    path = os.path.join(cache_dir(), key + ".aot")
+    if not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        # stale/corrupt/other-machine entry: drop it and rebuild
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def save(key: str, compiled) -> bool:
+    """Serialize a jax Compiled to the cache (atomic write)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        d = cache_dir()
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump((payload, in_tree, out_tree), f)
+        os.replace(tmp, os.path.join(d, key + ".aot"))
+        return True
+    except Exception:
+        return False
