@@ -1312,9 +1312,74 @@ def _ref_lrn(a, size, alpha=1e-4, beta=0.75, k=1.0):
     return a / (k + alpha / size * div) ** beta
 
 
+def _ref_embedding_backward(g, idx, num_weights):
+    out = jnp.zeros((num_weights, g.shape[-1]), g.dtype)
+    return out.at[idx.reshape(-1)].add(g.reshape(-1, g.shape[-1]))
+
+
+def _ref_nll_backward(g, lp, tgt):
+    oh = jax.nn.one_hot(tgt, lp.shape[1], dtype=lp.dtype)
+    return -oh * g / lp.shape[0]
+
+
+def _ref_aap2d_backward(g, a):
+    kh, kw = a.shape[-2] // g.shape[-2], a.shape[-1] // g.shape[-1]
+    return jnp.kron(g / (kh * kw), jnp.ones((kh, kw), g.dtype))
+
+
+# round-5 parity stragglers (LTORCH_COVERAGE.md)
+wave5_opinfos = [
+    OpInfo(name="view", op=lambda a: ltorch.view(a, (20,)), ref=lambda a: jnp.reshape(a, (20,)),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (4, 5), dt),))]),
+           dtypes=F32_64),
+    OpInfo(name="copy", op=ltorch.copy,
+           ref=lambda a, b: jnp.broadcast_to(b, a.shape).astype(a.dtype),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 5), dt), make_tensor(rng, (5,), dt)))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="scaled_mm", op=lambda a, b: ltorch.scaled_mm(a, b, 2.0, 0.5),
+           ref=lambda a, b: (a.astype(jnp.float32) * 2.0) @ (b.astype(jnp.float32) * 0.5),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 8), dt), make_tensor(rng, (8, 3), dt)))]),
+           dtypes=F32),
+    OpInfo(name="torch_type", op=lambda a: ltorch.torch_type(a, "float32"),
+           ref=lambda a: a.astype(jnp.float32),
+           sample_generator=lambda rng, dt: iter([SampleInput((make_tensor(rng, (3, 4), dt),))]),
+           dtypes=F32_64, supports_grad=False),
+    OpInfo(name="log_softmax_backward",
+           op=lambda g, o: ltorch.log_softmax_backward(g, o, 1),
+           ref=lambda g, o: g - jnp.exp(o) * jnp.sum(g, 1, keepdims=True),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 7), dt),
+                            jax.nn.log_softmax(make_tensor(rng, (4, 7), dt), 1)))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="embedding_backward",
+           op=lambda g, idx: ltorch.embedding_backward(g, idx, 10),
+           ref=lambda g, idx: _ref_embedding_backward(g, idx, 10),
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (4, 6, 3), dt),
+                            jnp.asarray(rng.randint(0, 10, (4, 6)))))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="nll_loss_backward",
+           op=lambda g, lp, t: ltorch.nll_loss_backward(g, lp, t, reduction="mean"),
+           ref=_ref_nll_backward,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((jnp.asarray(1.0, jnp.float32),
+                            jax.nn.log_softmax(make_tensor(rng, (6, 4), dt), 1),
+                            jnp.asarray(rng.randint(0, 4, (6,)))))]),
+           dtypes=F32, supports_grad=False),
+    OpInfo(name="adaptive_avg_pool2d_backward",
+           op=ltorch.adaptive_avg_pool2d_backward, ref=_ref_aap2d_backward,
+           sample_generator=lambda rng, dt: iter([
+               SampleInput((make_tensor(rng, (2, 3, 4, 4), dt),
+                            make_tensor(rng, (2, 3, 8, 8), dt)))]),
+           dtypes=F32, supports_grad=False),
+]
+
+
 all_opinfos = (unary_opinfos + binary_opinfos + reduction_opinfos + shape_opinfos
                + nn_opinfos + widened_opinfos + wave2_opinfos + wave3_opinfos
-               + wave4_opinfos)
+               + wave4_opinfos + wave5_opinfos)
 grad_opinfos = [oi for oi in all_opinfos if oi.supports_grad]
 
 

@@ -419,3 +419,26 @@ class TestAliasGroupCacheKeys:
         t = torch.randn(3, 3)
         out = cm(t, t.view(3, 3))  # same storage, same layout -> one buffer
         np.testing.assert_allclose(np.asarray(out), (t + t).numpy(), atol=1e-6)
+
+
+def test_item_symbol_returns_python_number(rng):
+    from thunder_tpu.ops import ltorch
+
+    v = tt.jit(lambda a: ltorch.item(a))(jnp.asarray([3.25]))
+    assert float(v) == 3.25
+    with pytest.raises(Exception, match="item"):
+        tt.jit(lambda a: ltorch.item(a))(jnp.ones((2, 2)))
+
+
+def test_exponential_key_sampler(rng):
+    import jax as _jax
+
+    from thunder_tpu.ops import ltorch
+
+    key = _jax.random.PRNGKey(3)
+    out = tt.jit(lambda a, k: ltorch.exponential(a, 2.0, key=k))(jnp.ones((2000,)), key)
+    m = float(jnp.mean(out))
+    assert abs(m - 0.5) < 0.06, m  # mean of Exp(rate=2) is 0.5
+    assert float(jnp.min(out)) >= 0.0
+    with pytest.raises(Exception, match="rng key"):
+        tt.jit(lambda a: ltorch.exponential(a, 2.0))(jnp.ones((4,)))

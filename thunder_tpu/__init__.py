@@ -14,6 +14,7 @@ Public API mirrors the reference where it makes sense:
 """
 from __future__ import annotations
 
+import functools
 import time
 from numbers import Number
 from typing import Any, Callable, Optional, Sequence
@@ -81,8 +82,10 @@ def _unwrap(x):
     return data if data is not None and hasattr(x, "requires_grad") else x
 
 
-def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None = None) -> tuple[TraceCtx, Any, list, list]:
-    """Trace fn by calling it with proxies. Returns (trace, treedef, tensor_mask, leaves)."""
+def _acquire_with(fn: Callable, args, kwargs, grad_mask, call) -> tuple[TraceCtx, Any, list, list]:
+    """Shared acquisition core: proxify tensor leaves, run `call(pargs,
+    pkwargs)` under the trace context, pack side effects. The direct and
+    interpreted frontends differ only in the call strategy."""
     leaves, treedef = tree_flatten((args, kwargs))
     trc = TraceCtx(fn)
     proxy_leaves = []
@@ -99,7 +102,7 @@ def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None =
                 tensor_mask.append(False)
         trc.args = tuple(p for p, m in zip(proxy_leaves, tensor_mask) if m)
         pargs, pkwargs = tree_unflatten(treedef, proxy_leaves)
-        result = fn(*pargs, **pkwargs)
+        result = call(pargs, pkwargs)
         if trc.side_effects:
             # recorded mutations ride as extra outputs; the epilogue replays
             # them onto their owners after execution (reference epilogue
@@ -108,6 +111,42 @@ def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None =
         else:
             prims.python_return(result)
     return trc, treedef, tensor_mask, leaves
+
+
+def acquire_trace(fn: Callable, args, kwargs, grad_mask: Sequence[bool] | None = None) -> tuple[TraceCtx, Any, list, list]:
+    """Trace fn by calling it with proxies. Returns (trace, treedef, tensor_mask, leaves)."""
+    return _acquire_with(fn, args, kwargs, grad_mask,
+                         lambda pargs, pkwargs: fn(*pargs, **pkwargs))
+
+
+def acquire_trace_interpreted(fn: Callable, args, kwargs,
+                              grad_mask: Sequence[bool] | None = None,
+                              sharp_edges: str = "allow"):
+    """acquire_trace through the bytecode-interpreter frontend: same proxy
+    passing and return convention, but fn's python executes opcode-by-opcode
+    (lookasides, sharp-edge checks). This is how ThunderModule runs under
+    interpretation="python interpreter" — every tensor still arrives as an
+    explicit arg (the params dict), so the direct-path prologue machinery
+    applies unchanged and distributed/quantization transforms compose."""
+    import warnings
+
+    from .frontend.interpreter import Interpreter, InterpreterError, Provenance, unwrap, wrap
+
+    def on_sharp_edge(msg: str) -> None:
+        if sharp_edges == "error":
+            raise InterpreterError(f"sharp edge: {msg}")
+        if sharp_edges == "warn":
+            warnings.warn(f"thunder_tpu jit sharp edge: {msg}")
+
+    def call(pargs, pkwargs):
+        interp = Interpreter(on_sharp_edge=on_sharp_edge)
+        return unwrap(interp.call(
+            wrap(fn),
+            [wrap(a, Provenance("arg", i)) for i, a in enumerate(pargs)],
+            {k: wrap(v, Provenance("arg", k)) for k, v in pkwargs.items()},
+        ))
+
+    return _acquire_with(fn, args, kwargs, grad_mask, call)
 
 
 def build_prologue(trc: TraceCtx, tensor_mask, leaves) -> TraceCtx:
@@ -199,7 +238,13 @@ class ThunderCompiledFunction(EpilogueMixin):
     def _compile(self, args, kwargs, key) -> CacheEntry:
         cd, cs = self._cd, self._cs
         t0 = time.perf_counter_ns()
-        trc, treedef, tensor_mask, leaves = acquire_trace(cd.fn, args, kwargs)
+        if cd.compile_options.get("_acquire_interpretation"):
+            acquire = functools.partial(
+                acquire_trace_interpreted,
+                sharp_edges=cd.compile_options.get("_sharp_edges", "allow"))
+        else:
+            acquire = acquire_trace
+        trc, treedef, tensor_mask, leaves = acquire(cd.fn, args, kwargs)
         cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
         t1 = time.perf_counter_ns()
@@ -324,6 +369,14 @@ def jit(
     if interpretation is not None:
         if interpretation not in ("python interpreter", "interpreter"):
             raise ValueError(f"unknown interpretation mode {interpretation!r}")
+        if isinstance(fn, Module):
+            # modules keep the full ThunderModule surface (overrides,
+            # distributed transforms, TrainStep); only the ACQUISITION runs
+            # through the bytecode interpreter (acquire_trace_interpreted)
+            return ThunderModule(fn, executors=executors, cache=cache, transforms=transforms,
+                                 disable_fusion=disable_fusion,
+                                 _acquire_interpretation=interpretation,
+                                 _sharp_edges=sharp_edges, **compile_options)
         from .frontend.compiled import InterpretedFunction
 
         return InterpretedFunction(fn, executors=executors, sharp_edges=sharp_edges,

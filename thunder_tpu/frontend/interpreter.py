@@ -687,6 +687,17 @@ class Interpreter:
         frame.locals.pop(ins.argval, None)
         return None
 
+    def op_DELETE_DEREF(self, frame, fn, ins):
+        cell = frame.cells.get(ins.argval)
+        try:
+            if cell is None:
+                raise ValueError
+            del cell.cell_contents
+        except ValueError:
+            # match CPython: deleting a missing/empty cell raises NameError
+            raise NameError(f"free variable '{ins.argval}' referenced before assignment")
+        return None
+
     def op_LOAD_GLOBAL(self, frame, fn, ins):
         name = ins.argval
         if name in frame.f_globals:

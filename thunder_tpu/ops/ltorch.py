@@ -2942,7 +2942,9 @@ def view(a, *shape):
 @torchsymbol(name="item", method_names=("item",), id="torch.Tensor.item")
 def item(a):
     """Tensor.item() -> NumberProxy (a DEVICE_SYNC_OP prim: forces a host
-    read at execution, never fuses)."""
+    read at execution, never fuses). The value is unbacked at trace time, so
+    it can be RETURNED but not branched/computed on inside the traced
+    program — same contract as the reference's data-dependent item."""
     return prims.item(a)
 
 
