@@ -426,3 +426,100 @@ class TestExpertParallel:
         with pytest.raises(AssertionError, match="divide"):
             moe_ep_forward(params, x, mesh=make_mesh({"ep": 4}, devices=jax.devices()[:4]),
                            n_expert_per_token=2)
+
+
+class TestDegenerateAndUnevenMeshes:
+    """SURVEY §4 items 7-8: dp=1 degenerate meshes must behave exactly like
+    no mesh at all, and padded FSDP must survive shapes where MANY dims are
+    indivisible, not just the vocab-330 case."""
+
+    def _model_pair(self, cfg_kwargs=None):
+        from thunder_tpu.models.litgpt import Config, GPTForCausalLM
+
+        cfg = Config.from_name("tiny-llama2", **(cfg_kwargs or {}))
+        m = GPTForCausalLM(cfg)
+        init = {k: np.asarray(p.data).copy() for k, p in m.named_parameters()}
+        ref = GPTForCausalLM(cfg)
+        for k, p in ref.named_parameters():
+            p.data = jnp.asarray(init[k])
+        return cfg, m, ref
+
+    def test_dp1_degenerate_mesh_matches_no_mesh(self, rng):
+        from thunder_tpu import optim
+        from thunder_tpu.training import TrainStep
+
+        cfg, m, ref = self._model_pair()
+        idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)))
+        tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)))
+        tm = tt.jit(m)
+        ddp(tm, make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+        loss = float(TrainStep(tm, optim.AdamW(lr=1e-3))(idx, tgt))
+        ref_loss = float(TrainStep(tt.jit(ref), optim.AdamW(lr=1e-3))(idx, tgt))
+        assert abs(loss - ref_loss) < 1e-6
+
+    def test_fsdp1_degenerate_mesh_matches_no_mesh(self, rng):
+        from thunder_tpu import optim
+        from thunder_tpu.training import TrainStep
+
+        cfg, m, ref = self._model_pair()
+        idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 32)))
+        tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 32)))
+        tm = tt.jit(m)
+        fsdp(tm, make_mesh({"fsdp": 1}, devices=jax.devices()[:1]), min_shard_numel=1)
+        loss = float(TrainStep(tm, optim.AdamW(lr=1e-3))(idx, tgt))
+        ref_loss = float(TrainStep(tt.jit(ref), optim.AdamW(lr=1e-3))(idx, tgt))
+        assert abs(loss - ref_loss) < 1e-6
+
+    @pytest.mark.parametrize("zero", [2, 3])
+    def test_fsdp_every_param_dim_indivisible(self, zero, rng):
+        """Model where EVERY 2-D weight's dim 0 is indivisible by the mesh
+        (7, 13, 29 rows over 8 shards): padding, backward unpadding, and the
+        state_dict round trip must all hold."""
+        from thunder_tpu import optim
+        from thunder_tpu.ops import ltorch
+        from thunder_tpu.training import TrainStep
+
+        class OddNet(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(10, 7, seed=11)
+                self.b = nn.Linear(7, 13, seed=12)
+                self.c = nn.Linear(13, 29, seed=13)
+
+            def forward(self, x, y):
+                h = ltorch.gelu(self.a(x))
+                h = ltorch.tanh(self.b(h))
+                return ltorch.mse_loss(self.c(h), y)
+
+        x = jnp.asarray(rng.randn(8, 10), jnp.float32)
+        y = jnp.zeros((8, 29), jnp.float32)
+        ref_loss = float(TrainStep(tt.jit(OddNet()), optim.AdamW(lr=1e-2))(x, y))
+
+        tm = tt.jit(OddNet())
+        fsdp(tm, make_mesh({"fsdp": 8}), min_shard_numel=1, zero=zero)
+        step = TrainStep(tm, optim.AdamW(lr=1e-2))
+        loss = float(step(x, y))
+        assert abs(loss - ref_loss) < 1e-5
+        # full (unpadded) state_dict after the identical update
+        ref2 = OddNet()
+        ref_step = TrainStep(tt.jit(ref2), optim.AdamW(lr=1e-2))
+        ref_step(x, y)
+        sd = tm.state_dict()
+        for k, v in ref2.named_parameters():
+            np.testing.assert_allclose(np.asarray(sd[k]), np.asarray(v.data),
+                                       atol=2e-5, err_msg=k)
+
+    def test_uneven_batch_refused_loudly(self, rng):
+        """A batch size indivisible by the data axis must raise, not silently
+        truncate."""
+        from thunder_tpu import optim
+        from thunder_tpu.models.litgpt import GPTForCausalLM
+        from thunder_tpu.training import TrainStep
+
+        cfg, m, _ = self._model_pair()
+        idx = jnp.asarray(rng.randint(0, cfg.vocab_size, (3, 32)))
+        tgt = jnp.asarray(rng.randint(0, cfg.vocab_size, (3, 32)))
+        tm = tt.jit(m)
+        ddp(tm, make_mesh({"dp": 4}, devices=jax.devices()[:4]))
+        with pytest.raises(Exception, match="divisible|divide"):
+            TrainStep(tm, optim.AdamW(lr=1e-3))(idx, tgt)

@@ -1,0 +1,40 @@
+"""Every quickstart in examples/quickstart runs end-to-end on CPU (the
+reference ships runnable notebook examples; these are the scriptable
+equivalent and rot loudly here if an API they use drifts)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QS = os.path.join(REPO, "examples", "quickstart")
+
+# every quickstart runs, each at its tiny config (args select it where the
+# script takes one)
+SCRIPTS = {
+    "pretrain.py": [],
+    "interpreter_frontend.py": [],
+    "serving_quantized.py": ["int8"],
+    "serving_quantized_nf4": None,  # alias row, resolved below
+    "distributed_fsdp.py": [],
+    "gspmd_training.py": [],
+    "fp8_training.py": [],
+    "hf_llm.py": [],
+    "hf_generate.py": ["--tiny"],
+}
+
+
+@pytest.mark.parametrize("script", sorted(SCRIPTS))
+def test_quickstart_runs(script):
+    if script == "serving_quantized_nf4":
+        path, args = os.path.join(QS, "serving_quantized.py"), ["nf4"]
+    else:
+        path, args = os.path.join(QS, script), SCRIPTS[script]
+    assert os.path.exists(path), f"{path} missing but listed in README"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    out = subprocess.run([sys.executable, path, *args], env=env,
+                         capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, f"{script} failed:\n{out.stderr[-1500:]}"
