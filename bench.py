@@ -89,6 +89,15 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     from thunder_tpu.models.litgpt import Config, GPTForCausalLM
     from thunder_tpu.training import TrainStep
 
+    obs_artifact = os.environ.get("BENCH_OBS_ARTIFACT")
+    if obs_artifact:
+        # one timeline per bench run, shared by the cold and warm phases
+        # (append: each phase is a subprocess); BENCH_OBS=1 sets this up
+        from thunder_tpu import observability
+
+        observability.enable(obs_artifact, append=True)
+        observability.event("bench_phase", model=model_name, B=B, T=T)
+
     ckpt = os.environ.get("BENCH_CKPT") == "1"
     cfg = Config.from_name(model_name, block_size=T, activation_checkpoint=ckpt)
     model = GPTForCausalLM(cfg)
@@ -254,6 +263,15 @@ def _bench_row(model_name: str, B: int, T: int, iters: int, ckpt: bool = False) 
 def main():
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     phase = os.environ.get("BENCH_PHASE", "")
+
+    if os.environ.get("BENCH_OBS") == "1" and "BENCH_OBS_ARTIFACT" not in os.environ:
+        # observability timeline artifact next to BENCH_LATEST.jsonl; the
+        # fused phases (subprocesses) append their spans/counters to it —
+        # inspect with `python tools/obs_summary.py OBS_TIMELINE.jsonl`
+        artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "OBS_TIMELINE.jsonl")
+        open(artifact, "w").close()  # fresh timeline per bench run
+        os.environ["BENCH_OBS_ARTIFACT"] = artifact
 
     if phase:
         if phase not in ("fused", "handwritten"):

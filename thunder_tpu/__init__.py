@@ -62,7 +62,13 @@ set_default_executors(_pallas_exs + [xlaex.ex])
 # (utils/compile_cache.py; TT_NO_COMPILE_CACHE=1 disables)
 from .utils.compile_cache import enable_persistent_cache  # noqa: E402
 
+# structured spans/counters over the whole pipeline (stdlib-only; enabled by
+# TT_OBS=1 / TT_OBS_FILE=... or observability.enable())
+from . import observability  # noqa: E402
+
 __version__ = "0.1.0"
+
+_obs_key_digest = observability.key_digest
 
 
 # ---------------------------------------------------------------------------
@@ -237,46 +243,78 @@ class ThunderCompiledFunction(EpilogueMixin):
     # -- compilation pipeline (reference thunder/__init__.py:439-635) --
     def _compile(self, args, kwargs, key) -> CacheEntry:
         cd, cs = self._cd, self._cs
-        t0 = time.perf_counter_ns()
-        if cd.compile_options.get("_acquire_interpretation"):
-            acquire = functools.partial(
-                acquire_trace_interpreted,
-                sharp_edges=cd.compile_options.get("_sharp_edges", "allow"))
-        else:
-            acquire = acquire_trace
-        trc, treedef, tensor_mask, leaves = acquire(cd.fn, args, kwargs)
-        cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
+        key_digest = _obs_key_digest(key)
+        phases: list = []
+        root = observability.span("compile", fn=self.__name__, cache_key=key_digest,
+                                  frontend="interpreter" if cd.compile_options.get(
+                                      "_acquire_interpretation") else "direct")
+        with root:
+            t0 = time.perf_counter_ns()
+            if cd.compile_options.get("_acquire_interpretation"):
+                acquire = functools.partial(
+                    acquire_trace_interpreted,
+                    sharp_edges=cd.compile_options.get("_sharp_edges", "allow"))
+            else:
+                acquire = acquire_trace
+            with observability.span("acquisition") as sp:
+                trc, treedef, tensor_mask, leaves = acquire(cd.fn, args, kwargs)
+                sp.set(bsyms=len(trc.bound_symbols))
+            phases.append(sp)
+            cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
-        t1 = time.perf_counter_ns()
-        traces = [trc]
-        pro = build_prologue(trc, tensor_mask, leaves)
+            t1 = time.perf_counter_ns()
+            traces = [trc]
+            pro = build_prologue(trc, tensor_mask, leaves)
 
-        for tf in self._transforms:
-            pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=cd)
+            for tf in self._transforms:
+                with observability.span(f"transform:{type(tf).__name__}") as sp:
+                    pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=cd)
+                    sp.set(bsyms=len(trc.bound_symbols))
+                phases.append(sp)
+                traces.append(trc)
+
+            with observability.span("transform:dce") as sp:
+                trc = dce(trc)
+                sp.set(bsyms=len(trc.bound_symbols))
+            phases.append(sp)
             traces.append(trc)
 
-        trc = dce(trc)
-        traces.append(trc)
+            from .executors.passes import transform_for_execution
 
-        from .executors.passes import transform_for_execution
-
-        executors = resolve_executors(cd.executors or None)
-        if cd.disable_fusion:
-            executors = [e for e in executors if not e.is_fusion_executor()]
-        ex_trc = transform_for_execution(trc, executors)
-        traces.append(ex_trc)
-
-        for tf in self._transforms:
-            ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=cd)
+            executors = resolve_executors(cd.executors or None)
+            if cd.disable_fusion:
+                executors = [e for e in executors if not e.is_fusion_executor()]
+            with observability.span("executor_dispatch",
+                                    executors=[e.name for e in executors]) as sp:
+                ex_trc = transform_for_execution(trc, executors)
+                sp.set(bsyms=len(ex_trc.bound_symbols),
+                       fusions=sum(1 for b in ex_trc.bound_symbols
+                                   if getattr(b.sym, "module", None) == "xla"))
+            phases.append(sp)
             traces.append(ex_trc)
 
-        cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
+            for tf in self._transforms:
+                with observability.span(f"transform_post:{type(tf).__name__}") as sp:
+                    ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=cd)
+                phases.append(sp)
+                traces.append(ex_trc)
 
-        t2 = time.perf_counter_ns()
-        computation_fn = ex_trc.python_callable()
-        prologue_fn = pro.python_callable()
-        cs.last_compile_time_ns = time.perf_counter_ns() - t2
+            cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
 
+            t2 = time.perf_counter_ns()
+            with observability.span("codegen") as sp:
+                computation_fn = ex_trc.python_callable()
+                prologue_fn = pro.python_callable()
+            phases.append(sp)
+            cs.last_compile_time_ns = time.perf_counter_ns() - t2
+
+        cs.last_compile_report = {
+            "fn": self.__name__,
+            "cache_key": key_digest,
+            "total_ms": round(root.dur_ms, 3),
+            "phases": [{"name": p.name, "dur_ms": round(p.dur_ms, 3), **p.attrs}
+                       for p in phases],
+        }
         cs.last_traces = traces
         cs.last_prologue_traces = [pro]
         entry = CacheEntry(
@@ -314,9 +352,20 @@ class ThunderCompiledFunction(EpilogueMixin):
         entry = self._cache.get(key)
         if entry is None:
             cs.cache_misses += 1
+            if observability.enabled():
+                from .observability import metrics as _m
+
+                _m.record_cache("trace", "miss", fn=self.__name__)
+                _m.record_recompile(
+                    _m.REASON_SHAPE_CHANGE if self._cache else _m.REASON_CACHE_MISS,
+                    fn=self.__name__, cache_key=_obs_key_digest(key))
             entry = self._compile(args, kwargs, key)
         else:
             cs.cache_hits += 1
+            if observability.enabled():
+                from .observability import metrics as _m
+
+                _m.record_cache("trace", "hit", fn=self.__name__)
         tensor_leaves = [_unwrap(l) for l, m in zip(leaves, tensor_mask) if m]
         flat_inputs = entry.prologue_fn(*tensor_leaves)
         out = entry.computation_fn(*flat_inputs)

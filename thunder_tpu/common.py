@@ -21,6 +21,9 @@ class CompileStats:
         self.last_traces: list = []
         self.last_backward_traces: list = []
         self.last_prologue_traces: list = []
+        # phase-by-phase record of the most recent compile, populated by the
+        # jit drivers on every compile (observability.last_compile_report)
+        self.last_compile_report: dict | None = None
 
 
 class CompileData:

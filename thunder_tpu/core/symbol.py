@@ -9,6 +9,7 @@ hierarchy they support (flash-attention claims ``sdpa`` whole; XLA fusion
 claims flattened prims)."""
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Optional, Sequence
 
 from .baseutils import SymbolInterface, check
@@ -16,10 +17,38 @@ from .codeutils import ContextInterner, prettyprint, flat_proxies
 from .proxies import Proxy, variableify
 from .trace import get_tracectx
 
+
+class _ThreadLocalStack(threading.local):
+    """A per-thread stack with list-like append/pop/indexing. Autocast
+    policies apply at symbol-bind time, so a process-global list would let
+    one tracing thread's ``with autocast():`` region cast-rewrite symbols
+    bound concurrently by ANOTHER thread (the trace context itself is
+    already a ContextVar — this matches it)."""
+
+    def __init__(self):
+        self._items: list = []
+
+    def append(self, x) -> None:
+        self._items.append(x)
+
+    def pop(self):
+        return self._items.pop()
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+
 # stack of active in-forward autocast policies (transforms/autocast.py
 # autocast_ctx); entries are callables (sym, args, kwargs) -> (args, kwargs),
-# or None for an enabled=False region
-_autocast_stack: list = []
+# or None for an enabled=False region. Thread-local: concurrent tracing
+# threads must not cross-apply each other's policies.
+_autocast_stack = _ThreadLocalStack()
 
 
 class OpTags:

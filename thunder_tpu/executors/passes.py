@@ -13,6 +13,8 @@ from ..core.prims import PrimIDs
 from ..core.symbol import BoundSymbol, OpTags
 from ..core.trace import TraceCtx, from_trace, tracectx
 from ..extend import Executor, FusionExecutor, get_always_executors
+from ..observability import events as _obs
+from ..observability import metrics as _obs_metrics
 
 _STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
 
@@ -73,8 +75,10 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
             f"tried {[e.name for e in executors]}"
         )
 
-    for bsym in trace.bound_symbols:
-        lower(bsym)
+    with _obs.span("claim", bsyms=len(trace.bound_symbols)) as sp:
+        for bsym in trace.bound_symbols:
+            lower(bsym)
+        sp.set(claimed=len(out_bsyms))
 
     claimed = from_trace(trace)
     claimed.bound_symbols = out_bsyms
@@ -84,7 +88,12 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
 
     for ex in executors:
         if isinstance(ex, FusionExecutor) or ex.is_fusion_executor():
-            claimed = ex.fusion_pass(claimed)
+            with _obs.span(f"fusion:{ex.name}") as sp:
+                claimed = ex.fusion_pass(claimed)
+                regions = [b for b in claimed.bound_symbols if b.sym.executor is ex]
+                sp.set(regions=len(regions))
+            _obs_metrics.record_fusion(ex.name, len(regions),
+                                       sum(len(b.subsymbols) for b in regions))
     # eager frees for op-by-op execution (reference passes.py:261); fused
     # regions don't need it but the DELs between them are harmless
     from ..core.transform_common import del_last_used

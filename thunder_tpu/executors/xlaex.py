@@ -19,6 +19,8 @@ from ..core.proxies import Proxy, TensorProxy, variableify
 from ..core.symbol import BoundSymbol, OpTags, Symbol
 from ..core.trace import TraceCtx, from_trace
 from ..extend import FusionExecutor, register_executor
+from ..observability import events as _obs
+from ..observability import runtime as _obs_runtime
 
 _STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
 _NOFUSE_IDS = (PrimIDs.ITEM, PrimIDs.PRINT, PrimIDs.DEVICE_PUT,
@@ -105,11 +107,29 @@ class XLAFusionExecutor(FusionExecutor):
         subtrace._name = name
 
         raw_fn = subtrace.python_callable()
-        jfn = jax.jit(raw_fn)
+
+        def scoped_fn(*args):
+            # the HLO traced under this scope carries the fusion name, so
+            # device profiles (xprof) map rows back to trace symbols
+            with _obs_runtime.fusion_scope(name):
+                return raw_fn(*args)
+
+        jfn = jax.jit(scoped_fn)
 
         fusion_sym = Symbol(name, None, id=f"xla.{name}", is_prim=True, executor=self, module="xla")
 
+        first_call = [True]
+
         def impl(*args):
+            if first_call[0]:
+                # jax.jit compiles lazily: the first dispatch pays jax
+                # trace + StableHLO lowering + XLA backend compile
+                first_call[0] = False
+                with _obs.span("xla_compile", fusion=name, n_ops=len(region)):
+                    return jfn(*args)
+            if _obs._BUS.enabled:
+                with _obs_runtime.annotate_call(name):
+                    return jfn(*args)
             return jfn(*args)
 
         impl.__name__ = name

@@ -14,6 +14,9 @@ from typing import Any, Callable, Optional, Sequence
 from ..common import CompileStats
 from ..core.pytree import tree_flatten
 from ..core.transform_common import dce
+from ..observability import events as _obs
+from ..observability import metrics as _obs_metrics
+from ..observability.events import key_digest as _key_digest
 from .jit_ext import _is_tensor_like, _unwrap_param, general_jit
 
 
@@ -80,38 +83,67 @@ class InterpretedFunction:
         from ..extend import resolve_executors
 
         cs = self._cs
-        t0 = time.perf_counter_ns()
-        res, treedef, mask, leaves = general_jit(self.fn, args, kwargs,
-                                                 sharp_edges=self.sharp_edges,
-                                                 lookasides=self.lookasides,
-                                                 symbolic_numbers=self.cache_option == "symbolic values",
-                                                 record_log=self.record_interpreter_log)
-        cs.last_interpreter_log = list(res.log)
-        if self._print_interpreter_log and res.log:
-            print("\n".join(res.log))
-        cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
+        key_digest = _key_digest(shape_key)
+        phases: list = []
+        root = _obs.span("compile", fn=self.__name__, cache_key=key_digest,
+                         frontend="interpreter")
+        with root:
+            t0 = time.perf_counter_ns()
+            with _obs.span("acquisition") as sp:
+                res, treedef, mask, leaves = general_jit(self.fn, args, kwargs,
+                                                         sharp_edges=self.sharp_edges,
+                                                         lookasides=self.lookasides,
+                                                         symbolic_numbers=self.cache_option == "symbolic values",
+                                                         record_log=self.record_interpreter_log)
+                sp.set(bsyms=len(res.computation_trc.bound_symbols))
+            phases.append(sp)
+            cs.last_interpreter_log = list(res.log)
+            if self._print_interpreter_log and res.log:
+                print("\n".join(res.log))
+            cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
-        t1 = time.perf_counter_ns()
-        pro, trc = res.prologue_trc, res.computation_trc
-        traces = [trc]
-        for tf in self.transforms:
-            pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=None)
+            t1 = time.perf_counter_ns()
+            pro, trc = res.prologue_trc, res.computation_trc
+            traces = [trc]
+            for tf in self.transforms:
+                with _obs.span(f"transform:{type(tf).__name__}") as sp:
+                    pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=None)
+                    sp.set(bsyms=len(trc.bound_symbols))
+                phases.append(sp)
+                traces.append(trc)
+            with _obs.span("transform:dce") as sp:
+                trc = dce(trc)
+                sp.set(bsyms=len(trc.bound_symbols))
+            phases.append(sp)
             traces.append(trc)
-        trc = dce(trc)
-        traces.append(trc)
-        executors = resolve_executors(self.executors or None)
-        if self.disable_fusion:
-            executors = [e for e in executors if not e.is_fusion_executor()]
-        ex_trc = transform_for_execution(trc, executors)
-        traces.append(ex_trc)
-        for tf in self.transforms:
-            ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=None)
+            executors = resolve_executors(self.executors or None)
+            if self.disable_fusion:
+                executors = [e for e in executors if not e.is_fusion_executor()]
+            with _obs.span("executor_dispatch", executors=[e.name for e in executors]) as sp:
+                ex_trc = transform_for_execution(trc, executors)
+                sp.set(bsyms=len(ex_trc.bound_symbols))
+            phases.append(sp)
             traces.append(ex_trc)
-        cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
+            for tf in self.transforms:
+                with _obs.span(f"transform_post:{type(tf).__name__}") as sp:
+                    ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=None)
+                phases.append(sp)
+                traces.append(ex_trc)
+            cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
 
-        t2 = time.perf_counter_ns()
-        entry = InterpretedEntry(pro.python_callable(), ex_trc.python_callable(), pro, ex_trc, shape_key)
-        cs.last_compile_time_ns = time.perf_counter_ns() - t2
+            t2 = time.perf_counter_ns()
+            with _obs.span("codegen") as sp:
+                entry = InterpretedEntry(pro.python_callable(), ex_trc.python_callable(),
+                                         pro, ex_trc, shape_key)
+            phases.append(sp)
+            cs.last_compile_time_ns = time.perf_counter_ns() - t2
+        cs.last_compile_report = {
+            "fn": self.__name__,
+            "cache_key": key_digest,
+            "total_ms": round(root.dur_ms, 3),
+            "phases": [{"name": p.name, "dur_ms": round(p.dur_ms, 3), **p.attrs}
+                       for p in phases],
+        }
         cs.last_traces = traces
         cs.last_prologue_traces = [pro]
         self._entries.append(entry)
@@ -127,6 +159,7 @@ class InterpretedFunction:
             # the caller asserts inputs never change shape/type)
             entry = self._entries[0]
             cs.cache_hits += 1
+            _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
             tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
             return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
         shape_key = self._shape_key(leaves, mask)
@@ -141,16 +174,26 @@ class InterpretedFunction:
             self._entries.clear()
             return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
         # a cache hit is the first prologue that runs without raising
+        guard_failed = False
         for entry in self._entries:
             if entry.shape_key != shape_key:
                 continue
             try:
                 flat_inputs = entry.prologue_fn(*tensor_leaves)
             except Exception:
+                guard_failed = True
                 continue
             cs.cache_hits += 1
+            _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
             return entry.computation_fn(*flat_inputs)
         cs.cache_misses += 1
+        if _obs.enabled():
+            _obs_metrics.record_cache("trace", "miss", fn=self.__name__)
+            _obs_metrics.record_recompile(
+                _obs_metrics.REASON_SHAPE_CHANGE if self._entries
+                else _obs_metrics.REASON_CACHE_MISS,
+                fn=self.__name__, cache_key=_key_digest(shape_key),
+                guard_failed=guard_failed)
         entry = self._compile(args, kwargs, shape_key)
         flat_inputs = entry.prologue_fn(*tensor_leaves)
         return entry.computation_fn(*flat_inputs)

@@ -1,0 +1,59 @@
+"""thunder_tpu.observability: structured spans, metrics, and diagnostics.
+
+The compile pipeline and runtime emit a machine-readable timeline of what
+they did — compile-phase spans (acquisition, transforms, executor dispatch,
+XLA compile), cache hit/miss/evict counters, reason-coded recompile events,
+fusion formation, per-step latency. See docs/observability.md for the JSONL
+schema and tools/obs_summary.py for the CLI view.
+
+Quick start:
+    import thunder_tpu as tt
+    tt.observability.enable("/tmp/tt.jsonl")   # or TT_OBS=1 / TT_OBS_FILE=...
+    cfn = tt.jit(fn); cfn(x)
+    tt.observability.summary()                 # aggregated spans/counters
+    tt.observability.last_compile_report(cfn)  # last compile, phase by phase
+"""
+from __future__ import annotations
+
+from .events import (  # noqa: F401
+    counters,
+    disable,
+    dump,
+    enable,
+    enabled,
+    event,
+    inc,
+    key_digest,
+    records,
+    reset,
+    span,
+    summary,
+)
+from .metrics import (  # noqa: F401
+    REASON_CACHE_MISS,
+    REASON_CODES,
+    REASON_FALLBACK,
+    REASON_SHAPE_CHANGE,
+    REASON_STALE_KEY,
+    cache_stats,
+    record_cache,
+    record_executable_size,
+    record_fusion,
+    record_recompile,
+)
+from .runtime import StepTimer, annotate_call, fusion_scope, step_span  # noqa: F401
+
+
+def last_compile_report(cfn) -> dict | None:
+    """Phase-by-phase report of a compiled function's most recent compile:
+    {"fn", "trace", "cache_key", "total_ms", "phases": [{"name", "dur_ms",
+    ...tags}]}. Populated on every compile, even with recording disabled
+    (the driver always times its phases). Accepts anything jit() returns —
+    a ThunderCompiledFunction, InterpretedFunction, or ThunderModule."""
+    cs = getattr(cfn, "_cs", None)
+    if cs is None:
+        cfn_inner = getattr(cfn, "_cfn", None)
+        cs = getattr(cfn_inner, "_cs", None)
+    if cs is None:
+        raise ValueError(f"{cfn!r} is not a thunder_tpu-compiled function")
+    return getattr(cs, "last_compile_report", None)

@@ -1,0 +1,70 @@
+"""Pipeline metrics over the event bus: cache traffic, recompiles, fusions.
+
+Recompile *reason codes* are the machine-readable vocabulary shared by the
+jit drivers (thunder_tpu/__init__.py, frontend/compiled.py), the AOT step
+cache (training.py, utils/aot_cache.py) and the CLI (tools/obs_summary.py):
+
+  cache-miss                    first compile of this function/key
+  shape-change                  entries exist but none matches the call's
+                                input metadata (shape/dtype/mode flip)
+  fallback-after-runtime-error  an AOT-deserialized executable raised at
+                                run time; the retrace path took over
+  stale-key                     an AOT entry exists for these inputs but
+                                its model-code digest no longer matches
+
+Counter naming: ``<cache>.<hit|miss|evict>`` for cache traffic (caches:
+``trace`` — the per-function specialization cache, ``aot`` — the serialized
+whole-step executable cache), ``recompile.<reason>`` for recompiles,
+``fusion.regions`` / ``fusion.ops`` for fusion formation.
+"""
+from __future__ import annotations
+
+from . import events
+
+REASON_CACHE_MISS = "cache-miss"
+REASON_SHAPE_CHANGE = "shape-change"
+REASON_FALLBACK = "fallback-after-runtime-error"
+REASON_STALE_KEY = "stale-key"
+
+REASON_CODES = (REASON_CACHE_MISS, REASON_SHAPE_CHANGE, REASON_FALLBACK, REASON_STALE_KEY)
+
+
+def record_cache(cache: str, outcome: str, **attrs) -> None:
+    """One cache lookup outcome: outcome in {"hit", "miss", "evict"}."""
+    if not events.enabled():
+        return
+    events.inc(f"{cache}.{outcome}", **attrs)
+
+
+def record_recompile(reason: str, **attrs) -> None:
+    """A compile that a cache could not serve, tagged with why."""
+    if not events.enabled():
+        return
+    events.inc(f"recompile.{reason}")
+    events.event("recompile", reason=reason, **attrs)
+
+
+def record_fusion(executor: str, n_regions: int, n_ops: int, **attrs) -> None:
+    """Fusion-pass outcome for one executor over one trace."""
+    if not events.enabled():
+        return
+    events.inc("fusion.regions", n_regions, executor=executor)
+    events.inc("fusion.ops", n_ops, executor=executor)
+    events.event("fusion_pass", executor=executor, regions=n_regions, ops=n_ops, **attrs)
+
+
+def record_executable_size(cache: str, nbytes: int, **attrs) -> None:
+    """Serialized-executable byte size (AOT save / load)."""
+    if not events.enabled():
+        return
+    events.event("executable_bytes", cache=cache, bytes=int(nbytes), **attrs)
+
+
+def cache_stats() -> dict[str, dict[str, int]]:
+    """{"trace": {"hit": 3, "miss": 1}, "aot": {...}} from the live counters."""
+    out: dict[str, dict[str, int]] = {}
+    for name, v in events.counters().items():
+        cache, _, outcome = name.partition(".")
+        if outcome in ("hit", "miss", "evict"):
+            out.setdefault(cache, {})[outcome] = v
+    return out

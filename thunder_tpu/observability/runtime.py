@@ -1,0 +1,92 @@
+"""Runtime-side observability: per-step latency spans and profiler mapping.
+
+Two concerns live here, both strictly opt-in on the hot path:
+
+* ``step_span`` — a latency span per training/inference step (TrainStep
+  wraps its ``__call__``). With the bus disabled it returns a shared no-op
+  context manager: one attribute read, no allocation, so the bench step
+  time is untouched (the acceptance bar is < 1% regression).
+
+* ``fusion_scope`` — ``jax.named_scope`` around each fusion region's traced
+  computation, so the ops inside a device profile (xprof/tensorboard) carry
+  the trace-symbol-derived fusion name (``xla_fusion_3``) instead of
+  anonymous HLO. Name metadata is baked at trace time and costs nothing at
+  run time, so it is always on. ``annotate_call`` adds the matching
+  host-side ``jax.profiler.TraceAnnotation`` per dispatch when recording.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from . import events
+
+_NULL = contextlib.nullcontext()
+
+
+def step_span(name: str = "step", **attrs):
+    """Latency span for one runtime step; no-op unless recording."""
+    if not events.enabled():
+        return _NULL
+    return events.span(name, **attrs)
+
+
+def fusion_scope(name: str):
+    """Trace-time name scope: HLO produced under it carries ``name`` in its
+    metadata, mapping device-profile rows back to trace symbols."""
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+def annotate_call(name: str):
+    """Host-side profiler annotation for one dispatch (recording only)."""
+    if not events.enabled():
+        return _NULL
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class StepTimer:
+    """Aggregating step-latency recorder: ``with timer.record(): step()``.
+
+    Keeps simple order statistics locally (the event bus keeps the raw
+    timeline) so harnesses can read mean/p50/p95 without re-parsing JSONL.
+    """
+
+    def __init__(self, name: str = "step", keep: int = 1024):
+        self.name = name
+        self.keep = keep
+        self.durations_ms: list[float] = []
+
+    @contextlib.contextmanager
+    def record(self, **attrs):
+        import time
+
+        t0 = time.perf_counter()
+        with step_span(self.name, **attrs):
+            yield
+        dur = (time.perf_counter() - t0) * 1e3
+        self.durations_ms.append(dur)
+        if len(self.durations_ms) > self.keep:
+            del self.durations_ms[: -self.keep]
+
+    def stats(self) -> Optional[dict]:
+        if not self.durations_ms:
+            return None
+        xs = sorted(self.durations_ms)
+        n = len(xs)
+        return {
+            "count": n,
+            "mean_ms": round(sum(xs) / n, 3),
+            "p50_ms": round(xs[n // 2], 3),
+            "p95_ms": round(xs[min(n - 1, int(n * 0.95))], 3),
+            "max_ms": round(xs[-1], 3),
+        }

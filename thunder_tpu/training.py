@@ -16,6 +16,9 @@ import jax
 import jax.numpy as jnp
 
 from .nn.module import Module, ThunderModule
+from .observability import events as _obs
+from .observability import metrics as _obs_metrics
+from .observability import runtime as _obs_runtime
 
 
 def _stable_val(v, depth: int = 0) -> str:
@@ -48,10 +51,31 @@ def _safe_repr(obj) -> str:
     return _stable_val(obj)
 
 
+def _aot_fallback_errors() -> tuple:
+    """Exception types a stale/mismatched AOT-deserialized executable raises:
+    argument-spec mismatches surface as TypeError/ValueError from the jax
+    Compiled call layer, ABI/runtime mismatches as XlaRuntimeError. Anything
+    else (a genuine bug) must propagate, not silently retrace."""
+    errs: list[type] = [TypeError, ValueError]
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        errs.append(XlaRuntimeError)
+    except Exception:
+        errs.append(RuntimeError)
+    return tuple(errs)
+
+
+_AOT_FALLBACK_ERRORS = _aot_fallback_errors()
+
+
 class _CompiledWithFallback:
     """A serialized-executable step that transparently falls back to the
     retrace path (the jax.jit fn) if inputs stop matching the compiled
-    shapes — AOT warm starts must never change semantics."""
+    shapes — AOT warm starts must never change semantics. The fallback is
+    never silent: it warns and emits a reason-coded recompile event, since
+    a persistently-failing executable would otherwise mask every runtime
+    error as a recompile."""
 
     def __init__(self, compiled, jit_fn_factory):
         self._compiled = compiled
@@ -62,8 +86,18 @@ class _CompiledWithFallback:
         if self._compiled is not None:
             try:
                 return self._compiled(*args)
-            except Exception:
+            except _AOT_FALLBACK_ERRORS as e:
+                import warnings
+
                 self._compiled = None
+                warnings.warn(
+                    f"AOT-cached executable failed at run time "
+                    f"({type(e).__name__}: {e}); falling back to the retrace "
+                    f"path. Delete the TT_AOT_CACHE_DIR entry if this "
+                    f"persists.", stacklevel=2)
+                _obs_metrics.record_recompile(
+                    _obs_metrics.REASON_FALLBACK,
+                    error=f"{type(e).__name__}: {e}"[:300])
         if self._jit_fn is None:
             self._jit_fn = self._factory()
         return self._jit_fn(*args)
@@ -230,12 +264,30 @@ class TrainStep:
         inputs = (tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
         return aot_cache.step_key(inputs=inputs, extra=extra)
 
+    def _model_digest(self) -> str:
+        """Digest of the model's computation (module tree + forward sources):
+        editing a forward must invalidate AOT warm starts even though the
+        input shape/dtype spec — the base key — is unchanged."""
+        from .utils import aot_cache
+
+        if self._model_digest_cached is None:
+            self._model_digest_cached = aot_cache.module_digest(self.tmodule.module)
+        return self._model_digest_cached
+
+    _model_digest_cached = None
+
     def _try_aot(self, tparam_arrays, frozen_arrays, args, kwargs) -> bool:
         from .utils import aot_cache
 
         if not aot_cache.enabled() or getattr(self.tmodule, "_dist_plan", None) is not None:
             return False
-        loaded = aot_cache.load(self._aot_key(tparam_arrays, frozen_arrays, args, kwargs))
+        base = self._aot_key(tparam_arrays, frozen_arrays, args, kwargs)
+        loaded, outcome = aot_cache.load_keyed(base, self._model_digest())
+        if outcome == "stale":
+            # an executable for these exact inputs exists but the model code
+            # changed underneath it: the cold trace that follows is forced
+            _obs_metrics.record_recompile(_obs_metrics.REASON_STALE_KEY,
+                                          key=base[:12])
         if loaded is None:
             return False
         train_step = self
@@ -260,7 +312,8 @@ class TrainStep:
             if getattr(self, "_effect_keys", None) is not None:
                 return  # buffer-mutation epilogues carry module refs: not cacheable
             compiled = lowered.compile()
-            aot_cache.save(self._aot_key(tparam_arrays, frozen_arrays, args, kwargs), compiled)
+            aot_cache.save_keyed(self._aot_key(tparam_arrays, frozen_arrays, args, kwargs),
+                                 self._model_digest(), compiled)
         except Exception:
             return
         # reuse the compiled program directly (the separate AOT lower/compile
@@ -305,8 +358,11 @@ class TrainStep:
                     tparam_arrays, frozen_arrays, self.opt_state, self._grad_acc, args, kwargs)
             self._grad_acc = None
         else:
-            loss, new_params, self.opt_state, effects = self._jitted(
-                tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            # host-side step latency (opt-in; dispatch is async so this is
+            # submission latency unless the caller reads the loss value)
+            with _obs_runtime.step_span("train_step"):
+                loss, new_params, self.opt_state, effects = self._jitted(
+                    tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
             if effects and getattr(self, "_effect_keys", None):
                 # epilogue: replay traced buffer mutations (running stats)
                 for (owner, name), v in zip(self._effect_keys, effects):
@@ -356,7 +412,8 @@ class TrainStep:
                 return loss, new_acc
 
             self._micro_jitted = jax.jit(micro, donate_argnums=(2,) if self.donate else ())
-        loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
+        with _obs_runtime.step_span("micro_step"):
+            loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
         return loss
 
     # -- distributed no_sync (pure-DDP and DDP/FSDP plans) --

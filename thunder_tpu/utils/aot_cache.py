@@ -20,10 +20,13 @@ and compile in seconds anyway).
 """
 from __future__ import annotations
 
+import glob
 import hashlib
 import os
 import pickle
 import tempfile
+
+from ..observability import metrics as _obs_metrics
 
 _SRC_DIGEST: str | None = None
 
@@ -101,19 +104,52 @@ def step_key(*, inputs, extra: str = "") -> str:
     return h.hexdigest()
 
 
+def module_digest(module) -> str:
+    """Digest of a Module's *computation*: the tree structure (child names +
+    class names + parameter/buffer names) and every distinct forward's
+    source. Editing a forward must invalidate AOT warm starts — the package
+    source_digest() only covers thunder_tpu's own files, so a user model
+    edit would otherwise run a stale executable with no signal at all."""
+    import inspect
+
+    h = hashlib.sha256()
+    for name, mod in module.named_modules():
+        cls = type(mod)
+        h.update(f"{name}:{cls.__module__}.{cls.__qualname__}".encode())
+        h.update(("|".join(sorted(getattr(mod, "_parameters", {}))) + ";"
+                  + "|".join(sorted(getattr(mod, "_buffers", {})))).encode())
+        fwd = getattr(cls, "forward", None)
+        if fwd is not None:
+            try:
+                h.update(inspect.getsource(fwd).encode())
+            except (OSError, TypeError):  # builtins / REPL-defined: best effort
+                h.update(repr(fwd.__code__.co_code).encode()
+                         if hasattr(fwd, "__code__") else b"?")
+    return h.hexdigest()
+
+
+def _deserialize(path: str):
+    from jax.experimental import serialize_executable as se
+
+    with open(path, "rb") as f:
+        payload, in_tree, out_tree = pickle.load(f)
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
 def load(key: str):
     """Deserialize a cached executable; None on miss or any failure."""
     path = os.path.join(cache_dir(), key + ".aot")
     if not os.path.exists(path):
+        _obs_metrics.record_cache("aot", "miss", key=key[:12])
         return None
     try:
-        from jax.experimental import serialize_executable as se
-
-        with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.load(f)
-        return se.deserialize_and_load(payload, in_tree, out_tree)
+        loaded = _deserialize(path)
+        _obs_metrics.record_cache("aot", "hit", key=key[:12],
+                                  bytes=os.path.getsize(path))
+        return loaded
     except Exception:
         # stale/corrupt/other-machine entry: drop it and rebuild
+        _obs_metrics.record_cache("aot", "evict", key=key[:12], why="corrupt")
         try:
             os.unlink(path)
         except OSError:
@@ -121,8 +157,49 @@ def load(key: str):
         return None
 
 
-def save(key: str, compiled) -> bool:
-    """Serialize a jax Compiled to the cache (atomic write)."""
+def load_keyed(base_key: str, digest: str):
+    """Lookup keyed by (inputs/config base key, model-code digest).
+
+    Returns ``(compiled_or_None, outcome)`` with outcome in:
+      "hit"    — exact entry deserialized
+      "stale"  — an entry exists for these inputs but under a DIFFERENT
+                 model digest (the forward was edited): evicted, cold trace
+      "miss"   — nothing cached for these inputs
+      "corrupt"— exact entry failed to deserialize: evicted
+    """
+    path = os.path.join(cache_dir(), f"{base_key}-{digest[:16]}.aot")
+    if os.path.exists(path):
+        try:
+            loaded = _deserialize(path)
+            _obs_metrics.record_cache("aot", "hit", key=base_key[:12],
+                                      bytes=os.path.getsize(path))
+            return loaded, "hit"
+        except Exception:
+            _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="corrupt")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None, "corrupt"
+    # `{base_key}*.aot` also sweeps pre-digest `{base_key}.aot` entries
+    # written by the legacy save(); base keys are fixed-length sha256 hex,
+    # so the prefix cannot match a different key
+    stale = glob.glob(os.path.join(cache_dir(), f"{base_key}*.aot"))
+    if stale:
+        # same inputs/config, different model code: never run it; evict so
+        # the directory doesn't accumulate one entry per edit
+        for p in stale:
+            _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="stale-key")
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        return None, "stale"
+    _obs_metrics.record_cache("aot", "miss", key=base_key[:12])
+    return None, "miss"
+
+
+def _write(name: str, compiled) -> bool:
     try:
         from jax.experimental import serialize_executable as se
 
@@ -131,7 +208,20 @@ def save(key: str, compiled) -> bool:
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
         with os.fdopen(fd, "wb") as f:
             pickle.dump((payload, in_tree, out_tree), f)
-        os.replace(tmp, os.path.join(d, key + ".aot"))
+        final = os.path.join(d, name)
+        os.replace(tmp, final)
+        _obs_metrics.record_executable_size("aot", os.path.getsize(final),
+                                            entry=name[:28])
         return True
     except Exception:
         return False
+
+
+def save(key: str, compiled) -> bool:
+    """Serialize a jax Compiled to the cache (atomic write)."""
+    return _write(key + ".aot", compiled)
+
+
+def save_keyed(base_key: str, digest: str, compiled) -> bool:
+    """Digest-keyed save (counterpart of load_keyed)."""
+    return _write(f"{base_key}-{digest[:16]}.aot", compiled)

@@ -54,6 +54,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> bool:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _enabled = True
+        from ..observability import events as _obs
+
+        _obs.event("persistent_cache_enabled", dir=cache_dir,
+                   entries=len(os.listdir(cache_dir)))
     except Exception:
         _enabled = False
     return _enabled

@@ -149,6 +149,14 @@ def save_reproducer(cfn, path: str) -> str:
     ]
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
+
+    # repro bundles carry the observability timeline when one is being
+    # recorded: the compile-phase spans and cache/recompile events that led
+    # to this trace are exactly the context a bug report needs
+    from ..observability import events as _obs
+
+    if _obs.enabled() and _obs.records():
+        _obs.dump(path + ".obs.jsonl")
     return path
 
 
@@ -271,6 +279,13 @@ def timing_report(cfn, *args, iters: int = 10, warmup: int = 2,
                 report[attr.replace("last_", "").replace("_ns", "_ms")] = v / 1e6
         report["cache_hits"] = getattr(cs, "cache_hits", None)
         report["cache_misses"] = getattr(cs, "cache_misses", None)
+        report["compile_report"] = getattr(cs, "last_compile_report", None)
+
+    from ..observability import events as _obs
+    from ..observability import metrics as _obs_metrics
+
+    if _obs.enabled():
+        report["obs_cache_stats"] = _obs_metrics.cache_stats()
     return report
 
 

@@ -1,0 +1,162 @@
+"""Pretty-print a thunder_tpu observability JSONL timeline.
+
+Reads the event-bus export (TT_OBS_FILE=..., observability.dump(), or the
+bench artifact OBS_TIMELINE.jsonl) and renders the three views an operator
+actually wants: the compile-phase span tree with durations, cache traffic
+and recompile reasons, and step-latency statistics.
+
+Usage:  python tools/obs_summary.py TIMELINE.jsonl [--top N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_STEP_SPANS = ("step", "train_step", "micro_step", "infer_step")
+
+
+def load(path: str) -> list[dict]:
+    recs = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"# skipping malformed line {ln}", file=sys.stderr)
+    return recs
+
+
+def _sid(r: dict, key: str = "span"):
+    """Span identity: (pid, span id). Span ids restart at 1 in every
+    process, and a bench artifact concatenates several processes' records —
+    pid keeps their trees from colliding (absent pid → one shared bucket)."""
+    return (r.get("pid", 0), r.get(key))
+
+
+def span_tree(recs: list[dict]) -> list[str]:
+    """Indented span forest, in start order, with durations and tags."""
+    spans = [r for r in recs if r.get("kind") == "span"]
+    by_id = {_sid(r): r for r in spans}
+    children: dict = {}
+    roots = []
+    for r in spans:
+        if r.get("parent") is not None and _sid(r, "parent") in by_id:
+            children.setdefault(_sid(r, "parent"), []).append(r)
+        else:
+            roots.append(r)
+    lines = []
+
+    def tag_str(r: dict) -> str:
+        attrs = r.get("attrs") or {}
+        shown = {k: v for k, v in attrs.items() if k != "executors"}
+        return ("  [" + " ".join(f"{k}={v}" for k, v in shown.items()) + "]") if shown else ""
+
+    def walk(r: dict, depth: int):
+        lines.append(f"{'  ' * depth}{r['name']:<{max(1, 28 - 2 * depth)}} "
+                     f"{r['dur_ms']:>10.2f} ms{tag_str(r)}")
+        for c in sorted(children.get(_sid(r), []), key=lambda x: x["ts_ms"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: (x.get("pid", 0), x["ts_ms"])):
+        walk(r, 0)
+    return lines
+
+
+def final_counters(recs: list[dict]) -> dict[str, int]:
+    """Counter totals summed across processes: within one pid the running
+    ``value`` (or its final snapshot) is authoritative; a multi-process
+    artifact (bench cold + warm phases) sums the per-pid finals."""
+    per_pid: dict = {}
+    for r in recs:
+        pid = r.get("pid", 0)
+        if r.get("kind") == "counter":
+            per_pid.setdefault(pid, {})[r["name"]] = r.get(
+                "value", per_pid.get(pid, {}).get(r["name"], 0))
+        elif r.get("kind") == "snapshot":
+            per_pid.setdefault(pid, {}).update(r.get("counters", {}))
+    out: dict[str, int] = {}
+    for finals in per_pid.values():
+        for name, v in finals.items():
+            out[name] = out.get(name, 0) + v
+    return out
+
+
+def cache_table(counters: dict[str, int]) -> list[str]:
+    caches: dict[str, dict[str, int]] = {}
+    for name, v in counters.items():
+        cache, _, outcome = name.partition(".")
+        if outcome in ("hit", "miss", "evict"):
+            caches.setdefault(cache, {})[outcome] = v
+    lines = []
+    for cache, stats in sorted(caches.items()):
+        hit, miss = stats.get("hit", 0), stats.get("miss", 0)
+        rate = f"{hit / (hit + miss):.0%}" if hit + miss else "-"
+        lines.append(f"  {cache:<8} hit={hit:<6} miss={miss:<6} "
+                     f"evict={stats.get('evict', 0):<4} hit-rate={rate}")
+    return lines
+
+
+def recompile_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    lines = []
+    for name, v in sorted(counters.items()):
+        if name.startswith("recompile."):
+            lines.append(f"  {name.removeprefix('recompile.'):<30} x{v}")
+    events = [r for r in recs if r.get("kind") == "event" and r.get("name") == "recompile"]
+    for r in events[-8:]:
+        attrs = r.get("attrs", {})
+        detail = " ".join(f"{k}={v}" for k, v in attrs.items() if k != "reason")
+        lines.append(f"    @{r['ts_ms']:.0f}ms  {attrs.get('reason', '?')}  {detail}")
+    return lines
+
+
+def step_stats(recs: list[dict]) -> list[str]:
+    durs = sorted(r["dur_ms"] for r in recs
+                  if r.get("kind") == "span" and r.get("name") in _STEP_SPANS)
+    if not durs:
+        return []
+    n = len(durs)
+    return [f"  steps={n}  mean={sum(durs) / n:.3f}ms  p50={durs[n // 2]:.3f}ms  "
+            f"p95={durs[min(n - 1, int(n * 0.95))]:.3f}ms  max={durs[-1]:.3f}ms"]
+
+
+def render(recs: list[dict], top: int = 0) -> str:
+    out = []
+    tree = span_tree(recs)
+    if top:
+        tree = tree[:top]
+    if tree:
+        out += ["== pipeline spans ==", *tree]
+    counters = final_counters(recs)
+    caches = cache_table(counters)
+    if caches:
+        out += ["", "== cache traffic ==", *caches]
+    rec = recompile_lines(recs, counters)
+    if rec:
+        out += ["", "== recompiles ==", *rec]
+    steps = step_stats(recs)
+    if steps:
+        out += ["", "== step latency (host-side) ==", *steps]
+    other = {k: v for k, v in counters.items()
+             if not k.startswith("recompile.")
+             and k.partition(".")[2] not in ("hit", "miss", "evict")}
+    if other:
+        out += ["", "== counters =="]
+        out += [f"  {k:<30} {v}" for k, v in sorted(other.items())]
+    return "\n".join(out) if out else "(empty timeline)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("timeline", help="JSONL file written by TT_OBS_FILE / observability.dump()")
+    ap.add_argument("--top", type=int, default=0, help="show at most N span-tree lines")
+    ns = ap.parse_args(argv)
+    print(render(load(ns.timeline), top=ns.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
