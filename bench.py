@@ -139,12 +139,52 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     compile_time_s = time.perf_counter() - t0
     for _ in range(warmup - 1):
         float(step(idx, tgt))  # value read: the only reliable sync on axon
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(idx, tgt)
-    loss_val = float(loss)  # forces the whole 20-step chain
-    dt = time.perf_counter() - t0
+
+    # BENCH_HOST=1: per-step host dispatch overhead (everything between step
+    # entry and the jitted handoff) via the opt-in host_overhead event —
+    # enabling the bus costs a few µs/step, so it's a separate mode
+    bench_host = os.environ.get("BENCH_HOST") == "1"
+    if bench_host:
+        from thunder_tpu import observability
+
+        if not observability.enabled():
+            observability.enable()  # in-memory ring buffer only
+        observability.reset()  # timed steps only
+
+    # BENCH_PREFETCH=1: fresh host batches per step, device_put'd on the
+    # prefetch thread (data/prefetch.py) so H2D overlaps the device step —
+    # the input-pipeline-included number instead of the resident-batch one
+    if os.environ.get("BENCH_PREFETCH") == "1":
+        from thunder_tpu.data.prefetch import prefetch_to_device
+
+        def _host_batches(n):
+            for _ in range(n):
+                yield (rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32),
+                       rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32))
+
+        stream = prefetch_to_device(_host_batches(iters), size=2)
+        t0 = time.perf_counter()
+        for xb, yb in stream:
+            loss = step(xb, yb)
+        loss_val = float(loss)
+        dt = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(idx, tgt)
+        loss_val = float(loss)  # forces the whole 20-step chain
+        dt = time.perf_counter() - t0
     tps = (B * T * iters) / dt
+
+    host_overhead_us = None
+    if bench_host:
+        from thunder_tpu.observability import events as _obs_events
+
+        durs = [r["attrs"]["us"] for r in _obs_events.records()
+                if r.get("kind") == "event" and r.get("name") == "host_overhead"
+                and r.get("attrs", {}).get("fn") == "train_step"]
+        if durs:
+            host_overhead_us = round(sum(durs) / len(durs), 1)
 
     return {
         "tps": tps,
@@ -154,6 +194,7 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         "peak_tflops": _peak_tflops(),
         "mem_gb": _mem_gb(step),
         "device_peak_gb": _device_peak_gb(),
+        "host_overhead_us": host_overhead_us,
     }
 
 

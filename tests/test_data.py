@@ -77,6 +77,34 @@ def test_minimal_file_both_paths(tmp_path):
         loader.close()
 
 
+def test_fallback_is_threaded_and_closes(token_file):
+    # the numpy fallback gets the same threaded overlap the native loader
+    # has: batches are assembled by a background worker into a bounded queue
+    path, _ = token_file
+    loader = TokenLoader(path, batch_size=2, seq_len=16, native=False)
+    assert loader._fb_thread is not None and loader._fb_thread.is_alive()
+    x, y = loader.next_batch()
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+    t = loader._fb_thread
+    loader.close()
+    assert not t.is_alive(), "fallback prefetch worker survived close()"
+
+
+def test_fallback_stream_deterministic_given_seed(token_file):
+    # one worker consumes the RandomState sequentially, so same-seed
+    # loaders serve identical streams despite the async assembly
+    path, _ = token_file
+    a = TokenLoader(path, batch_size=4, seq_len=32, seed=11, native=False)
+    b = TokenLoader(path, batch_size=4, seq_len=32, seed=11, native=False)
+    for _ in range(6):
+        xa, ya = a.next_batch()
+        xb, yb = b.next_batch()
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    a.close()
+    b.close()
+
+
 def test_batches_vary(token_file):
     path, _ = token_file
     loader = TokenLoader(path, batch_size=2, seq_len=32, seed=3)

@@ -1,0 +1,469 @@
+"""Steady-state dispatch fast paths (ISSUE 5): TrainStep's epoch-cached
+param split, InterpretedFunction's leaf-plan + keyed MRU entry cache, the
+hoisted observability gate, and the host_overhead metric.
+
+The InterpretedFunction tests install a stub ``_compile`` so the dispatch
+machinery (flatten, leaf plan, shape key, bucket probe, guards, reason
+codes) is exercised without the bytecode-interpreter frontend — which keeps
+them meaningful on interpreters the frontend gates out (CI runs 3.12; this
+dispatch layer is version-independent).
+"""
+import importlib.util
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, observability, optim
+from thunder_tpu.frontend import compiled as C
+from thunder_tpu.frontend.compiled import InterpretedEntry, InterpretedFunction
+from thunder_tpu.nn.module import structure_epoch
+from thunder_tpu.ops import ltorch
+from thunder_tpu.training import TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4, seed=0)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc(x), y)
+
+
+def _step_and_batch(rng):
+    net = _Net()
+    step = TrainStep(tt.jit(net), optim.AdamW(lr=0.05))
+    x = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    y = jnp.asarray(rng.rand(4, 4).astype(np.float32))
+    return net, step, x, y
+
+
+# ---------------------------------------------------------------------------
+# TrainStep: epoch-cached split
+# ---------------------------------------------------------------------------
+
+
+class TestTrainStepFastPath:
+    def test_steady_state_does_not_walk_module_tree(self, rng, monkeypatch):
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        float(step(x, y))
+        assert step._split_walks == 1, "steady-state step re-split the params"
+
+        walks = {"n": 0}
+        orig = nn.Module.named_modules
+
+        def counting(self, prefix=""):
+            walks["n"] += 1
+            return orig(self, prefix)
+
+        monkeypatch.setattr(nn.Module, "named_modules", counting)
+        l3 = float(step(x, y))
+        l4 = float(step(x, y))
+        assert walks["n"] == 0, "steady-state step walked the module tree"
+        assert step._split_walks == 1
+        assert np.isfinite(l3) and np.isfinite(l4)
+
+    def test_requires_grad_flip_invalidates_cached_split(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        t0, f0, _ = step._split_arrays()
+        walks = step._split_walks
+        assert "fc.weight" in t0 and "fc.weight" not in f0
+        step._split_arrays()
+        assert step._split_walks == walks  # epoch unchanged: cached
+
+        net.fc.weight.requires_grad = False
+        t1, f1, _ = step._split_arrays()
+        assert step._split_walks == walks + 1
+        assert "fc.weight" in f1 and "fc.weight" not in t1
+
+        net.fc.weight.requires_grad = True
+        t2, f2, _ = step._split_arrays()
+        assert "fc.weight" in t2 and "fc.weight" not in f2
+
+    def test_param_add_and_remove_invalidate_cached_split(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        step._split_arrays()
+        walks = step._split_walks
+        net.register_parameter("extra", nn.Parameter(jnp.zeros((2,))))
+        t1, _, _ = step._split_arrays()
+        assert step._split_walks == walks + 1
+        assert "extra" in t1
+        del net.extra
+        t2, _, _ = step._split_arrays()
+        assert "extra" not in t2
+
+    def test_structure_epoch_moves_on_mutations(self):
+        net = _Net()
+        e0 = structure_epoch()
+        net.fc.bias.requires_grad = False
+        assert structure_epoch() > e0
+        e1 = structure_epoch()
+        net.register_buffer("scale", jnp.ones(()))
+        assert structure_epoch() > e1
+        e2 = structure_epoch()
+        net.eval()
+        assert structure_epoch() > e2
+        # the stores themselves are instrumented: the direct dict writes
+        # transforms use (bypassing __setattr__/register_*) bump too
+        e3 = structure_epoch()
+        net.fc._parameters["weight"] = nn.Parameter(jnp.zeros((4, 8)))
+        assert structure_epoch() > e3
+        e4 = structure_epoch()
+        net._buffers["fresh"] = jnp.ones(())
+        assert structure_epoch() > e4
+        # ...but buffer VALUE rebinds (effect replay does one per step) do not
+        e5 = structure_epoch()
+        net._buffers["fresh"] = jnp.full((), 2.0)
+        assert structure_epoch() == e5
+        # `store |= {...}` goes through the C-level dict update unless
+        # __ior__ is overridden — it must invalidate like any other write
+        e6 = structure_epoch()
+        net.fc._parameters |= {"weight": nn.Parameter(jnp.zeros((4, 8)))}
+        assert structure_epoch() > e6
+
+    def test_noop_mutations_do_not_bump(self):
+        # the torch idioms of re-asserting train() / requires_grad every
+        # iteration must not defeat the fast path with spurious epoch bumps
+        net = _Net()
+        net.train()  # already training: no-op
+        e0 = structure_epoch()
+        net.train()
+        net.fc.weight.requires_grad = True  # already True
+        net.training = True  # direct no-op mode write
+        assert structure_epoch() == e0
+        net.eval()  # a REAL flip still bumps
+        assert structure_epoch() > e0
+
+    def test_micro_step_uses_cached_split(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        float(step.micro_step(x, y))
+        float(step.micro_step(x, y))
+        assert step._split_walks == 1, "micro_step re-split the params"
+        step._grad_acc = None  # discard the window: plain steps resume
+
+    def test_direct_dict_param_replacement_invalidates(self, rng):
+        # weight-tying / transform style: install an ALREADY-CONSTRUCTED
+        # Parameter via the direct store write — the cached split must drop
+        # its stale reference and serve (and write back through) the new one
+        net, step, x, y = _step_and_batch(rng)
+        step._split_arrays()
+        walks = step._split_walks
+        replacement = nn.Parameter(jnp.zeros_like(net.fc.weight.data))
+        net.fc._parameters["weight"] = replacement
+        t1, _, pairs = step._split_arrays()
+        assert step._split_walks == walks + 1
+        assert t1["fc.weight"] is replacement.data
+        assert any(p is replacement for _, p in pairs)
+
+    def test_mode_flip_during_no_sync_keeps_raising(self, rng):
+        # the mode-flip-inside-accumulation-window error must fire on EVERY
+        # step until the window ends — consuming the structure epoch before
+        # raising would swallow the flip and silently run the stale program
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        mode0 = step._active_mode
+        step._grad_acc = {}  # simulate an open no_sync accumulation window
+        step.tmodule.eval()
+        with pytest.raises(RuntimeError, match="no_sync"):
+            step._sync_mode()
+        with pytest.raises(RuntimeError, match="no_sync"):
+            step._sync_mode()  # second call must still see the flip
+        step._grad_acc = None  # window closed: the flip now takes effect
+        step._sync_mode()
+        assert step._active_mode != mode0
+
+    def test_buffer_values_reread_without_walk(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        net.register_buffer("scale", jnp.ones(()))
+        _, f0, _ = step._split_arrays()
+        walks = step._split_walks
+        assert float(f0["scale"]) == 1.0
+        # value rebind (what effect replay does) must NOT need a re-walk,
+        # yet the fresh value must flow into the next step's inputs
+        net._buffers["scale"] = jnp.full((), 2.0)
+        _, f1, _ = step._split_arrays()
+        assert step._split_walks == walks
+        assert float(f1["scale"]) == 2.0
+
+    def test_mode_flip_still_selects_program(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        mode0 = step._active_mode
+        step.tmodule.eval()
+        float(step(x, y))
+        assert step._active_mode != mode0, "eval() flip was not observed"
+        step.tmodule.train()
+        float(step(x, y))
+        assert step._active_mode == mode0
+
+    def test_write_back_updates_parameters(self, rng):
+        net, step, x, y = _step_and_batch(rng)
+        w0 = np.asarray(net.fc.weight.data).copy()
+        float(step(x, y))
+        float(step(x, y))
+        assert not np.array_equal(w0, np.asarray(net.fc.weight.data))
+
+
+# ---------------------------------------------------------------------------
+# observability: opt-in on, zero bus work off
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchObservability:
+    def test_disabled_mode_zero_bus_calls(self, rng, monkeypatch):
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        float(step(x, y))
+        assert not observability.enabled()
+
+        def boom(*a, **k):
+            raise AssertionError("event bus touched on the disabled hot path")
+
+        from thunder_tpu import training as T
+        from thunder_tpu.observability import events as ev
+
+        monkeypatch.setattr(ev, "event", boom)
+        monkeypatch.setattr(ev, "inc", boom)
+        monkeypatch.setattr(T._obs_runtime, "step_span", boom)
+        float(step(x, y))  # steady-state step: no bus calls, no span entry
+
+    def test_host_overhead_event_emitted_and_summarized(self, rng):
+        observability.reset()
+        observability.enable()
+        try:
+            net, step, x, y = _step_and_batch(rng)
+            float(step(x, y))  # build step: no host_overhead (compile skews it)
+            float(step(x, y))
+            float(step(x, y))
+            evs = [r for r in observability.records()
+                   if r["kind"] == "event" and r["name"] == "host_overhead"]
+            assert len(evs) == 2
+            assert all(r["attrs"]["fn"] == "train_step" for r in evs)
+            assert all(r["attrs"]["us"] > 0 for r in evs)
+
+            spec = importlib.util.spec_from_file_location(
+                "obs_summary", os.path.join(REPO, "tools", "obs_summary.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            out = mod.render(observability.records())
+            assert "host dispatch overhead" in out
+            assert "train_step" in out
+        finally:
+            observability.disable()
+            observability.reset()
+
+
+# ---------------------------------------------------------------------------
+# InterpretedFunction dispatch (stubbed compile)
+# ---------------------------------------------------------------------------
+
+
+def _fake_interpreted(fn=None, cache="constant values", prologue=None):
+    """InterpretedFunction whose _compile installs an identity entry — the
+    dispatch path (the unit under test) runs unchanged."""
+    cf = InterpretedFunction(fn or (lambda *a, **k: None), cache=cache)
+
+    def fake_compile(args, kwargs, shape_key):
+        entry = InterpretedEntry(prologue or (lambda *t: t), lambda *t: t,
+                                 None, None, shape_key)
+        cf._entries.append(entry)
+        cf._entries_by_key.setdefault(shape_key, []).insert(0, entry)
+        return entry
+
+    cf._compile = fake_compile
+    return cf
+
+
+class TestInterpretedDispatchFastPath:
+    def test_cache_hit_skips_remasking(self, monkeypatch):
+        calls = {"n": 0}
+        real = C._is_tensor_like
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(C, "_is_tensor_like", counting)
+        cf = _fake_interpreted()
+        x = jnp.ones((2, 3))
+        cf(x, 2)
+        first = calls["n"]
+        assert first > 0
+        cf(x, 2)
+        assert calls["n"] == first, "cache hit re-ran per-leaf masking"
+        assert cf.cache_hits == 1
+        # a scalar VALUE change reuses the leaf plan (same types) but is a
+        # distinct cache key -> new entry, still no re-masking
+        cf(x, 3)
+        assert calls["n"] == first
+        assert cf.cache_misses == 2
+
+    def test_keyed_bucket_mru_order(self):
+        cf = _fake_interpreted()
+        x = jnp.ones((2, 2))
+        cf(x)
+        key = cf._entries[0].shape_key
+        gate = {"open": False}
+
+        def guarded(*t):
+            if not gate["open"]:
+                raise RuntimeError("guard failed")
+            return t
+
+        picky = InterpretedEntry(guarded, lambda *t: t, None, None, key)
+        cf._entries.append(picky)
+        cf._entries_by_key[key].insert(0, picky)  # picky probes first
+
+        cf(x)  # picky's guard raises; the permissive entry hits
+        assert cf.cache_hits == 1
+        assert cf._entries_by_key[key][0] is not picky, "MRU did not promote the hit"
+        cf(x)  # steady state now probes the winner first
+        assert cf.cache_hits == 2
+
+    def test_all_guards_fail_recompiles_with_reason(self):
+        observability.reset()
+        observability.enable()
+        try:
+            attempts = {"n": 0}
+
+            def flaky_prologue(*t):
+                # passes on compile #1 (run 1), fails on the cache probe of
+                # call #2 (run 2), passes for the freshly recompiled entry
+                # (run 3) — a captured value changing between calls
+                attempts["n"] += 1
+                if attempts["n"] == 2:
+                    raise RuntimeError("captured value changed")
+                return t
+
+            cf = _fake_interpreted(prologue=flaky_prologue)
+            x = jnp.ones((3,))
+            cf(x)  # compile #1 (prologue run #1 passes)
+            cf(x)  # guard fails -> falls through to recompile
+            assert cf.cache_misses == 2
+            recs = [r for r in observability.records()
+                    if r["kind"] == "event" and r["name"] == "recompile"]
+            assert recs, "guard failure did not record a recompile"
+            last = recs[-1]["attrs"]
+            assert last["reason"] == "shape-change"
+            assert last["guard_failed"] is True
+        finally:
+            observability.disable()
+            observability.reset()
+
+    def test_same_input_mode_uses_precomputed_extraction(self, monkeypatch):
+        cf = _fake_interpreted(cache="same input")
+        x = jnp.ones((2, 2))
+        assert np.asarray(cf(x)[0]).shape == (2, 2)
+        calls = {"n": 0}
+
+        def counting(l):
+            calls["n"] += 1
+            return C._unwrap_param(l)
+
+        monkeypatch.setattr(C, "_is_tensor_like", lambda l: (_ for _ in ()).throw(
+            AssertionError("same-input hit re-masked leaves")))
+        out = cf(x)
+        assert cf.cache_hits == 1
+        assert np.asarray(out[0]).shape == (2, 2)
+
+    def test_disabled_mode_hit_path_zero_bus_calls(self, monkeypatch):
+        cf = _fake_interpreted()
+        x = jnp.ones((2,))
+        cf(x)
+        assert not observability.enabled()
+
+        def boom(*a, **k):
+            raise AssertionError("record_cache called with the bus disabled")
+
+        monkeypatch.setattr(C._obs_metrics, "record_cache", boom)
+        monkeypatch.setattr(C._obs, "event", boom)
+        cf(x)
+        assert cf.cache_hits == 1
+
+    def test_mru_promotion_thread_safe(self):
+        # two same-shape-key entries whose guards accept disjoint inputs,
+        # hammered from threads that alternate between them: every hit on a
+        # non-front entry promotes, so promotions race constantly. The
+        # bucket must never corrupt (lost entries => wrong routing or
+        # permanent recompiles) and no IndexError may escape.
+        import threading as th
+
+        cf = _fake_interpreted()
+        x0 = jnp.zeros((4,))
+        x1 = jnp.ones((4,))
+        cf(x0)  # seed an entry to learn the shape key
+        key = cf._entries[0].shape_key
+
+        def make_guard(want):
+            def prologue(*t):
+                if float(np.asarray(t[0])[0]) != want:
+                    raise RuntimeError("guard")
+                return t
+            return prologue
+
+        e0 = InterpretedEntry(make_guard(0.0), lambda *t: ("e0",) + t, None, None, key)
+        e1 = InterpretedEntry(make_guard(1.0), lambda *t: ("e1",) + t, None, None, key)
+        cf._entries[:] = [e0, e1]
+        cf._entries_by_key[key] = [e0, e1]
+
+        def routed_compile(args, kwargs, shape_key):
+            # a benignly-raced probe miss re-registers the right entry
+            # instead of polluting the bucket with a catch-all
+            e = e0 if float(np.asarray(args[0])[0]) == 0.0 else e1
+            with cf._mru_lock:
+                cf._entries_by_key.setdefault(shape_key, []).insert(0, e)
+            return e
+
+        cf._compile = routed_compile
+        errors = []
+
+        def worker(arr, tag):
+            try:
+                for _ in range(200):
+                    out = cf(arr)
+                    assert out[0] == tag, f"wrong entry routed: {out[0]} != {tag}"
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [th.Thread(target=worker, args=(x0, "e0")),
+                   th.Thread(target=worker, args=(x1, "e1")),
+                   th.Thread(target=worker, args=(x0, "e0")),
+                   th.Thread(target=worker, args=(x1, "e1"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert set(cf._entries_by_key[key]) == {e0, e1}
+
+    def test_cached_dispatch_python_work_bounded(self):
+        """Microbench regression guard: the cached dispatch path (flatten,
+        plan lookup, shape key, bucket probe) stays a handful of Python
+        calls — a new per-leaf loop of function calls would blow the bound."""
+        cf = _fake_interpreted()
+        x = jnp.ones((4, 4))
+        cf(x)   # compile
+        cf(x)   # warm the leaf-plan cache
+        counter = {"n": 0}
+
+        def prof(frame, event, arg):
+            if event == "call":
+                counter["n"] += 1
+
+        sys.setprofile(prof)
+        try:
+            cf(x)
+        finally:
+            sys.setprofile(None)
+        assert cf.cache_hits >= 2
+        assert counter["n"] <= 40, (
+            f"cached dispatch ran {counter['n']} Python calls (bound 40); "
+            f"host fast path regressed")

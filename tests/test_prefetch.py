@@ -1,0 +1,118 @@
+"""prefetch_to_device failure-mode contract (thunder_tpu/data/prefetch.py):
+ordering, clean exhaustion, worker-exception propagation, and deadlock-free
+early consumer exit."""
+import gc
+
+import numpy as np
+import pytest
+
+from thunder_tpu.data import TokenLoader, write_token_file
+from thunder_tpu.data.prefetch import prefetch_to_device
+
+
+def test_ordering_preserved():
+    items = [np.full((2, 2), i, np.int32) for i in range(20)]
+    out = list(prefetch_to_device(iter(items), size=3))
+    assert len(out) == 20
+    for i, x in enumerate(out):
+        assert int(np.asarray(x)[0, 0]) == i
+
+
+def test_default_transfer_lands_on_device():
+    import jax
+
+    out = list(prefetch_to_device(iter([np.arange(4, dtype=np.int32)]), size=2))
+    assert len(out) == 1
+    assert isinstance(out[0], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4))
+
+
+def test_pytree_batches_transfer_whole():
+    batches = [(np.zeros((2, 3)), np.ones((2, 3))) for _ in range(3)]
+    for x, y in prefetch_to_device(iter(batches), size=2):
+        assert np.asarray(x).shape == (2, 3)
+        assert float(np.asarray(y).sum()) == 6.0
+
+
+def test_exhaustion_terminates_cleanly():
+    p = prefetch_to_device(iter([np.zeros(1)]), size=2)
+    assert len(list(p)) == 1
+    assert list(p) == []  # exhausted iterator stays exhausted
+    p.close()
+
+
+def test_worker_exception_propagates_in_order():
+    def gen():
+        yield np.zeros(2)
+        yield np.ones(2)
+        raise ValueError("boom")
+
+    p = prefetch_to_device(gen(), size=2)
+    assert float(np.asarray(next(p)).sum()) == 0.0
+    assert float(np.asarray(next(p)).sum()) == 2.0
+    with pytest.raises(ValueError, match="boom"):
+        next(p)
+    with pytest.raises(StopIteration):
+        next(p)
+
+
+def test_transfer_exception_propagates():
+    def bad(x):
+        raise RuntimeError("transfer failed")
+
+    p = prefetch_to_device(iter([1, 2]), transfer=bad)
+    with pytest.raises(RuntimeError, match="transfer failed"):
+        next(p)
+
+
+def test_early_consumer_exit_no_deadlock():
+    def endless():
+        i = 0
+        while True:
+            yield np.full((4,), i, np.int32)
+            i += 1
+
+    p = prefetch_to_device(endless(), size=2)
+    for _ in range(3):
+        next(p)
+    t = p._thread
+    p.close()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "producer thread survived close()"
+
+
+def test_dropped_iterator_reaps_worker():
+    # the worker must not hold the iterator alive: dropping the consumer
+    # reference reaches __del__ -> close(), which stops the thread
+    p = prefetch_to_device(iter(range(10_000)), size=2, transfer=lambda x: x)
+    next(p)
+    t = p._thread
+    del p
+    gc.collect()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "worker leaked after the consumer was dropped"
+
+
+def test_context_manager_closes():
+    with prefetch_to_device(iter(range(100)), size=2, transfer=lambda x: x) as p:
+        assert next(p) == 0
+        t = p._thread
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_invalid_size_rejected():
+    with pytest.raises(ValueError):
+        prefetch_to_device(iter(()), size=0)
+
+
+def test_tokenloader_prefetched_stream(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    write_token_file(path, np.arange(5000) % 50000, token_bytes=2)
+    loader = TokenLoader(path, batch_size=2, seq_len=16, native=False)
+    stream = loader.prefetched(size=2)
+    for _ in range(4):
+        x, y = next(stream)
+        np.testing.assert_array_equal(np.asarray(x)[:, 1:], np.asarray(y)[:, :-1])
+    stream.close()
+    loader.close()

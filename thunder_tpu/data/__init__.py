@@ -8,11 +8,15 @@ from __future__ import annotations
 
 import ctypes
 import os
+import queue
 import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
+
+from .prefetch import (DevicePrefetchIterator, _drain_and_join,  # noqa: F401
+                       _stop_aware_put, prefetch_to_device)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libttloader.so")
@@ -41,6 +45,27 @@ def _build_native() -> Optional[str]:
             except OSError:
                 pass
             return None
+
+
+def _fallback_worker(tokens: np.ndarray, rng, batch_size: int, span: int,
+                     q: "queue.Queue", stop: threading.Event) -> None:
+    """Numpy-fallback batch assembler: same threaded overlap the native
+    loader has, so hosts without g++ still hide batch assembly behind the
+    device step. One worker consumes the RandomState sequentially, so the
+    batch stream is identical to the old synchronous path. Closes over its
+    state, NOT the TokenLoader — a bound method would keep the loader alive
+    and its close()/__del__ would never run."""
+    n = tokens.shape[0]
+    try:
+        while not stop.is_set():
+            offs = rng.randint(0, n - span + 1, batch_size)
+            buf = np.empty((batch_size, span), np.int32)
+            for i, o in enumerate(offs):
+                buf[i] = tokens[o: o + span].astype(np.int32)
+            if not _stop_aware_put(q, stop, buf):
+                return
+    except Exception as e:  # surfaces in the consumer's next next_batch()
+        _stop_aware_put(q, stop, e)
 
 
 _lib = None
@@ -89,6 +114,9 @@ class TokenLoader:
             )
             if not self._handle:
                 self._lib = None
+        self._fb_queue = None
+        self._fb_stop = None
+        self._fb_thread = None
         if self._lib is None:
             dtype = {1: np.uint8, 2: np.uint16, 4: np.int32}[token_bytes]
             self._tokens = np.memmap(path, dtype=dtype, mode="r")
@@ -98,7 +126,18 @@ class TokenLoader:
                     f"need at least seq_len+1={self.span}"
                 )
             self._rng = np.random.RandomState(seed)
-        self._buf = np.empty((batch_size, self.span), np.int32)
+            self._fb_queue = queue.Queue(maxsize=max(1, queue_depth))
+            self._fb_stop = threading.Event()
+            self._fb_thread = threading.Thread(
+                target=_fallback_worker,
+                args=(self._tokens, self._rng, batch_size, self.span,
+                      self._fb_queue, self._fb_stop),
+                name="tt-token-fallback", daemon=True)
+            self._fb_thread.start()
+        else:
+            # native output buffer; the fallback path receives
+            # worker-allocated buffers through _fb_queue instead
+            self._buf = np.empty((batch_size, self.span), np.int32)
 
     @property
     def is_native(self) -> bool:
@@ -117,19 +156,38 @@ class TokenLoader:
                 raise RuntimeError("native loader failed")
             batch = self._buf
         else:
-            n = self._tokens.shape[0]
-            # max valid start offset is n - span (inclusive), matching the
-            # native path's uniform_int_distribution(0, n - span)
-            offs = self._rng.randint(0, n - self.span + 1, self.batch_size)
-            for i, o in enumerate(offs):
-                self._buf[i] = self._tokens[o: o + self.span].astype(np.int32)
-            batch = self._buf
+            # offsets are drawn by the prefetch worker with the same rng
+            # consumption order the old synchronous path had (max valid
+            # start offset n - span inclusive, matching the native path's
+            # uniform_int_distribution(0, n - span))
+            while True:
+                try:
+                    batch = self._fb_queue.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if self._fb_thread is None or not self._fb_thread.is_alive():
+                        raise RuntimeError("fallback loader worker exited") from None
+            if isinstance(batch, Exception):
+                raise batch
         return batch[:, :-1].copy(), batch[:, 1:].copy()
+
+    def batches(self):
+        """Endless (inputs, targets) iterator — feed to prefetch_to_device."""
+        while True:
+            yield self.next_batch()
+
+    def prefetched(self, size: int = 2, sharding=None) -> DevicePrefetchIterator:
+        """Device-resident batch stream: a background thread jax.device_puts
+        upcoming batches so H2D transfer overlaps the device step."""
+        return prefetch_to_device(self.batches(), size=size, sharding=sharding)
 
     def close(self):
         if self._handle is not None:
             self._lib.ttl_destroy(self._handle)
             self._handle = None
+        if self._fb_stop is not None:
+            _drain_and_join(self._fb_queue, self._fb_stop, self._fb_thread)
+            self._fb_stop = None
 
     def __del__(self):
         try:

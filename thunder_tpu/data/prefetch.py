@@ -1,0 +1,168 @@
+"""Async host-to-device prefetch: overlap H2D transfer with the device step.
+
+A training loop that calls ``jax.device_put`` (or lets jit do the implicit
+transfer) inside the step loop serializes host->device copies with compute.
+``prefetch_to_device`` moves the transfer onto a background thread with a
+small bounded buffer (double-buffered by default): while the device runs
+step N, the host is already shipping batch N+1.
+
+    loader = TokenLoader(path, B, T)
+    for x, y in prefetch_to_device(loader.batches(), size=2):
+        loss = step(x, y)
+
+Failure-mode contract (tested in tests/test_prefetch.py):
+
+* ordering is preserved exactly;
+* iterator exhaustion terminates the consumer loop cleanly;
+* a worker exception (from the source iterator OR the transfer) re-raises
+  in the consumer at the position it occurred;
+* early consumer exit (break / del / close) never deadlocks the worker —
+  the producer's queue put is stop-aware, and ``close()`` drains the queue.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class _End:
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<end-of-stream>"
+
+
+_END = _End()
+
+
+def _stop_aware_put(q: queue.Queue, stop: threading.Event, item) -> bool:
+    """Bounded put that never blocks past a stop signal. False = consumer gone."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _drain_and_join(q: queue.Queue, stop: threading.Event,
+                    thread: Optional[threading.Thread], timeout: float = 5.0) -> None:
+    """Shared shutdown: signal stop, empty the queue so a producer blocked
+    on put() exits promptly, then join the worker."""
+    stop.set()
+    while True:
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            break
+    if thread is not None:
+        thread.join(timeout=timeout)
+
+
+def _prefetch_worker(it: Iterator, transfer: Callable, q: queue.Queue,
+                     stop: threading.Event) -> None:
+    try:
+        for item in it:
+            if stop.is_set():
+                return
+            if not _stop_aware_put(q, stop, transfer(item)):
+                return
+        _stop_aware_put(q, stop, _END)
+    except BaseException as e:  # noqa: BLE001 — re-raised in the consumer
+        _stop_aware_put(q, stop, e)
+
+
+class DevicePrefetchIterator:
+    """Iterator whose background thread ``jax.device_put``s upcoming items.
+
+    ``size`` bounds how many device-resident batches may be in flight
+    (buffer memory = size x batch bytes). ``sharding`` is forwarded to
+    ``jax.device_put`` (a ``Sharding``/``Device``); ``transfer`` overrides
+    the transfer function entirely (tests, custom layouts).
+    """
+
+    def __init__(self, iterable: Iterable, *, size: int = 2, sharding=None,
+                 transfer: Optional[Callable[[Any], Any]] = None):
+        if size < 1:
+            raise ValueError(f"prefetch size must be >= 1, got {size}")
+        if transfer is None:
+            import jax
+
+            def transfer(item):
+                if sharding is None:
+                    return jax.device_put(item)
+                return jax.device_put(item, sharding)
+
+        self._q: queue.Queue = queue.Queue(maxsize=size)
+        self._stop = threading.Event()
+        self._done = False
+        # the worker closes over the queue/stop-event, NOT self: a bound
+        # method would let the running thread keep this iterator alive, so a
+        # consumer that just drops the iterator would never reach __del__ and
+        # the producer would spin forever
+        self._thread = threading.Thread(
+            target=_prefetch_worker, args=(iter(iterable), transfer, self._q, self._stop),
+            name="tt-device-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> "DevicePrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # worker died without a sentinel (interpreter teardown
+                    # killed the daemon): drain what's left, then stop
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self._done = True
+                        raise StopIteration from None
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            self._stop.set()
+            raise item
+        return item
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Idempotent shutdown: unblocks and joins the worker."""
+        self._done = True
+        _drain_and_join(self._q, self._stop, self._thread)
+
+    def __enter__(self) -> "DevicePrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2, sharding=None,
+                       *, transfer: Optional[Callable[[Any], Any]] = None
+                       ) -> DevicePrefetchIterator:
+    """Wrap ``iterator`` so upcoming items are ``jax.device_put`` on a
+    background thread — H2D overlaps the consumer's compute. ``size=2`` is
+    classic double buffering; raise it only if batch production is bursty."""
+    return DevicePrefetchIterator(iterator, size=size, sharding=sharding,
+                                  transfer=transfer)

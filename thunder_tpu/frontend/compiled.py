@@ -8,6 +8,7 @@ environment change that invalidates the trace forces recompilation).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -56,8 +57,36 @@ class InterpretedFunction:
                                      or getattr(dbg, "record_interpreter_history", False))))
         self._print_interpreter_log = bool(dbg is not None and getattr(dbg, "show_interpreter_log", False))
         self._entries: list[InterpretedEntry] = []
+        # shape_key -> [entries], most-recently-hit first: cache lookup is
+        # one dict probe + (usually) one prologue run instead of a linear
+        # scan over every specialization ever compiled. Bucket MUTATIONS
+        # (MRU promotion, registration) hold _mru_lock so concurrent callers
+        # can't corrupt a bucket; the steady-state hit (front entry) never
+        # locks. Readers scan an atomic list(bucket) snapshot and mutations
+        # are single atomic list ops, so a racing promotion can never hide
+        # an entry from a scan (which would cost a recompile and grow a
+        # duplicate specialization).
+        self._entries_by_key: dict = {}
+        self._mru_lock = threading.Lock()
+        # (treedef, leaf types) -> (mask, tensor_idx, number_idx): repeat
+        # calls skip per-leaf _is_tensor_like re-masking. Keyed on the leaf
+        # TYPES too because a treedef alone does not determine tensor-ness
+        # (an int and an array flatten to the same treedef slot).
+        self._leaf_plans: dict = {}
         self._cs = CompileStats()
         self.__name__ = getattr(fn, "__name__", type(fn).__name__)
+
+    def _leaf_plan(self, leaves, treedef):
+        key = (treedef, tuple(map(type, leaves)))
+        plan = self._leaf_plans.get(key)
+        if plan is None:
+            mask = tuple(_is_tensor_like(l) for l in leaves)
+            tensor_idx = tuple(i for i, m in enumerate(mask) if m)
+            number_idx = tuple(
+                i for i, (l, m) in enumerate(zip(leaves, mask))
+                if not m and isinstance(l, (int, float)) and not isinstance(l, bool))
+            plan = self._leaf_plans[key] = (mask, tensor_idx, number_idx)
+        return plan
 
     def _shape_key(self, leaves, mask):
         symbolic = self.cache_option == "symbolic values"
@@ -147,47 +176,82 @@ class InterpretedFunction:
         cs.last_traces = traces
         cs.last_prologue_traces = [pro]
         self._entries.append(entry)
+        # newest specialization probes first: its guards match the call that
+        # just compiled it, which steady state repeats
+        with self._mru_lock:
+            self._entries_by_key.setdefault(shape_key, []).insert(0, entry)
         return entry
 
     def __call__(self, *args, **kwargs):
         cs = self._cs
         cs.calls += 1
-        leaves, _ = tree_flatten((args, kwargs))
-        mask = [_is_tensor_like(l) for l in leaves]
+        # one enabled() read gates every observability touch on this path:
+        # disabled mode (the default) must not even CALL into the bus
+        obs_on = _obs.enabled()
+        t_host = time.perf_counter_ns() if obs_on else 0
+        leaves, treedef = tree_flatten((args, kwargs))
+        mask, tensor_idx, number_idx = self._leaf_plan(leaves, treedef)
+        tensor_leaves = [_unwrap_param(leaves[i]) for i in tensor_idx]
         if self.cache_option == "same input" and self._entries:
             # reuse the sole entry unconditionally (reference SAME_INPUT:
             # the caller asserts inputs never change shape/type)
             entry = self._entries[0]
             cs.cache_hits += 1
-            _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
-            tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
-            return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
+            # run the prologue BEFORE the host_overhead timestamp, exactly
+            # like the keyed-hit path, so the metric is comparable across
+            # cache modes
+            flat_inputs = entry.prologue_fn(*tensor_leaves)
+            if obs_on:
+                _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
+                _obs.event("host_overhead", fn=self.__name__,
+                           us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
+            return entry.computation_fn(*flat_inputs)
         shape_key = self._shape_key(leaves, mask)
-        tensor_leaves = [_unwrap_param(l) for l, m in zip(leaves, mask) if m]
         if self.cache_option == "symbolic values":
             # the prologue takes the runtime numbers after the tensors
-            tensor_leaves = tensor_leaves + [
-                l for l, m in zip(leaves, mask)
-                if not m and isinstance(l, (int, float)) and not isinstance(l, bool)]
+            tensor_leaves = tensor_leaves + [leaves[i] for i in number_idx]
         if self.cache_option == "no caching":
             entry = self._compile(args, kwargs, shape_key)
             self._entries.clear()
+            with self._mru_lock:
+                self._entries_by_key.clear()
+            # this mode retains NOTHING between calls; keeping leaf plans
+            # would grow without bound under varying argument structures
+            self._leaf_plans.clear()
             return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
         # a cache hit is the first prologue that runs without raising
         guard_failed = False
-        for entry in self._entries:
-            if entry.shape_key != shape_key:
-                continue
-            try:
-                flat_inputs = entry.prologue_fn(*tensor_leaves)
-            except Exception:
-                guard_failed = True
-                continue
-            cs.cache_hits += 1
-            _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
-            return entry.computation_fn(*flat_inputs)
+        bucket = self._entries_by_key.get(shape_key)
+        if bucket is not None:
+            # scan an atomic snapshot: list(bucket) is one C-level copy under
+            # the GIL, and every bucket mutation (slice-assign promotion
+            # below, insert-at-front registration) keeps the live list
+            # complete at each instant — a racing promotion can therefore
+            # never hide an entry from this scan and force a spurious
+            # recompile, and the hit path stays lock-free
+            for i, entry in enumerate(list(bucket)):
+                try:
+                    flat_inputs = entry.prologue_fn(*tensor_leaves)
+                except Exception:
+                    guard_failed = True
+                    continue
+                if i:
+                    # MRU: the entry whose guards pass moves to the front so
+                    # the steady-state probe order stays one-deep. The
+                    # slice assignment replaces the contents in ONE atomic
+                    # operation — unlocked snapshots never see the entry
+                    # mid-flight (a remove+insert pair would have a window
+                    # where the entry is in neither position)
+                    with self._mru_lock:
+                        bucket[:] = [entry] + [e for e in bucket if e is not entry]
+                cs.cache_hits += 1
+                if obs_on:
+                    _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
+                    _obs.event("host_overhead", fn=self.__name__,
+                               us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
+                return entry.computation_fn(*flat_inputs)
         cs.cache_misses += 1
-        if _obs.enabled():
+        if obs_on:
             _obs_metrics.record_cache("trace", "miss", fn=self.__name__)
             _obs_metrics.record_recompile(
                 _obs_metrics.REASON_SHAPE_CHANGE if self._entries

@@ -1,4 +1,5 @@
-from .module import Module, ModuleDict, ModuleList, Parameter, Sequential, ThunderModule, functional_params
+from .module import (Module, ModuleDict, ModuleList, Parameter, Sequential,
+                     ThunderModule, functional_params, structure_epoch)
 from .layers import (
     Conv2d,
     Dropout,

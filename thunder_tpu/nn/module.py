@@ -8,6 +8,7 @@ functional call, so the computation trace takes parameters as explicit inputs
 (the same shape the reference achieves with prologue param-unpacking)."""
 from __future__ import annotations
 
+import itertools
 import math
 from contextlib import contextmanager
 from typing import Any, Callable, Iterator, Optional, Sequence
@@ -19,12 +20,126 @@ from ..core import dtypes
 from ..core.proxies import TensorProxy
 
 
+# Process-global structure epoch: bumped by every mutation that can change
+# the RESULT of a module-tree walk (param add/remove/replace, requires_grad
+# flip, buffer registration, train/eval flip, override install). The bumps
+# live in the store dicts themselves (_EpochDict/_SlotEpochDict below), so
+# the direct dict writes transforms use are covered too. Steady-state
+# consumers (TrainStep's cached param split) compare one integer instead of
+# re-walking the tree; an unrelated model's mutation merely forces one
+# harmless re-walk, never a stale read. Plain-attribute writes that walks
+# don't observe structurally (p.data, buffer value rebinds) deliberately do
+# NOT bump — they stay O(1) on the hot path.
+_structure_epoch = 0
+_epoch_source = itertools.count(1)
+
+
+def structure_epoch() -> int:
+    return _structure_epoch
+
+
+def _bump_structure_epoch() -> None:
+    # next() on itertools.count is atomic under the GIL, so two racing
+    # mutations always land distinct epochs — neither can collide with an
+    # epoch a consumer already cached
+    global _structure_epoch
+    _structure_epoch = next(_epoch_source)
+
+
+class _EpochDict(dict):
+    """Backing store for ``_parameters``/``_modules``/``_overrides``: every
+    mutation bumps the structure epoch — including the direct dict writes
+    transforms use (``mod._parameters["weight"] = qp``), which bypass
+    ``__setattr__``/``register_parameter``. Instrumenting the store itself
+    means there is exactly one invalidation point, so an epoch-cached
+    consumer (TrainStep's split) can never serve a stale Parameter
+    reference. Value replacement at an existing key DOES bump: the split
+    cache holds the old Parameter object by reference."""
+    __slots__ = ()
+
+    def __setitem__(self, key, value):
+        dict.__setitem__(self, key, value)
+        _bump_structure_epoch()
+
+    def __delitem__(self, key):
+        dict.__delitem__(self, key)
+        _bump_structure_epoch()
+
+    def pop(self, *args):
+        had = len(self)
+        out = dict.pop(self, *args)
+        if len(self) != had:
+            _bump_structure_epoch()
+        return out
+
+    def popitem(self):
+        out = dict.popitem(self)
+        _bump_structure_epoch()
+        return out
+
+    def clear(self):
+        if self:
+            dict.clear(self)
+            _bump_structure_epoch()
+
+    def update(self, *args, **kwargs):
+        dict.update(self, *args, **kwargs)
+        _bump_structure_epoch()
+
+    def __ior__(self, other):
+        # dict.__ior__ mutates through the C-level update, bypassing the
+        # overrides above; delegate to update() (virtual: subclasses keep
+        # their own bump semantics) so `store |= {...}` invalidates too
+        self.update(other)
+        return self
+
+    def setdefault(self, key, default=None):
+        if key in self:
+            return dict.__getitem__(self, key)
+        self[key] = default
+        return default
+
+
+class _SlotEpochDict(_EpochDict):
+    """``_buffers`` store: bumps only when the KEY SET changes. Buffer
+    *values* are rebound every step (effect replay writes
+    ``owner._buffers[name] = v``; ``update_buffer`` at runtime), and
+    epoch-cached consumers re-read values through the (owner, name) slot
+    each step anyway — bumping on value rebinds would invalidate the split
+    cache every step and destroy the dispatch fast path."""
+    __slots__ = ()
+
+    def __setitem__(self, key, value):
+        fresh = key not in self
+        dict.__setitem__(self, key, value)
+        if fresh:
+            _bump_structure_epoch()
+
+    def update(self, *args, **kwargs):
+        had = len(self)
+        dict.update(self, *args, **kwargs)
+        if len(self) != had:
+            _bump_structure_epoch()
+
+
 class Parameter:
     """A learnable leaf: jax array + requires_grad flag."""
 
     def __init__(self, data, requires_grad: bool = True):
         self.data = data
-        self.requires_grad = requires_grad
+        self._requires_grad = requires_grad
+
+    @property
+    def requires_grad(self) -> bool:
+        return self._requires_grad
+
+    @requires_grad.setter
+    def requires_grad(self, value: bool) -> None:
+        # no-op re-assertions (a loop pinning `p.requires_grad = False` every
+        # step) must not bump: each bump costs consumers a full re-walk
+        if bool(value) != self._requires_grad:
+            self._requires_grad = bool(value)
+            _bump_structure_epoch()
 
     @property
     def shape(self):
@@ -69,12 +184,14 @@ class Module:
     """Stateful module tree (torch-flavored API, jax-array parameters)."""
 
     def __init__(self):
-        object.__setattr__(self, "_parameters", {})
-        object.__setattr__(self, "_buffers", {})
-        object.__setattr__(self, "_modules", {})
+        object.__setattr__(self, "_parameters", _EpochDict())
+        object.__setattr__(self, "_buffers", _SlotEpochDict())
+        object.__setattr__(self, "_modules", _EpochDict())
         object.__setattr__(self, "training", True)
 
     def __setattr__(self, name: str, value: Any) -> None:
+        # the stores are epoch-instrumented dicts: each write/removal below
+        # bumps the structure epoch itself
         if isinstance(value, Parameter):
             self._parameters[name] = value
             self._buffers.pop(name, None)
@@ -83,7 +200,24 @@ class Module:
             self._modules[name] = value
             self._parameters.pop(name, None)
         else:
+            changed = (name == "training"
+                       and getattr(self, "training", None) != value)
             object.__setattr__(self, name, value)
+            if changed:
+                # direct mode writes (train()/eval() use object.__setattr__;
+                # this catches `m.training = False` done by hand). Write
+                # FIRST, bump SECOND — like every other bump site — so a
+                # concurrent reader can never cache the stale mode under the
+                # new epoch
+                _bump_structure_epoch()
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_modules"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]  # epoch-instrumented store bumps
+                return
+        object.__delattr__(self, name)
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
@@ -94,7 +228,7 @@ class Module:
         raise AttributeError(f"{type(self).__name__} has no attribute {name}")
 
     def register_buffer(self, name: str, value) -> None:
-        self._buffers[name] = value
+        self._buffers[name] = value  # bumps the epoch iff the name is new
 
     def update_buffer(self, name: str, value) -> None:
         """Write a buffer; inside a trace the write is recorded as a side
@@ -128,10 +262,18 @@ class Module:
             for p_name, p in mod._parameters.items():
                 yield (f"{mod_name}.{p_name}" if mod_name else p_name), p
 
-    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+    def named_buffer_slots(self, prefix: str = "") -> Iterator[tuple[str, "Module", str]]:
+        """(qualified name, owner module, buffer name) for every buffer —
+        the single naming authority for code that must re-read buffer
+        VALUES later through the owner slot (effect replay rebinds
+        ``owner._buffers[name]`` to a new array each step)."""
         for mod_name, mod in self.named_modules(prefix):
-            for b_name, b in mod._buffers.items():
-                yield (f"{mod_name}.{b_name}" if mod_name else b_name), b
+            for b_name in mod._buffers:
+                yield (f"{mod_name}.{b_name}" if mod_name else b_name), mod, b_name
+
+    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Any]]:
+        for q, mod, b_name in self.named_buffer_slots(prefix):
+            yield q, mod._buffers[b_name]
 
     def parameters(self) -> Iterator[Parameter]:
         for _, p in self.named_parameters():
@@ -185,8 +327,15 @@ class Module:
 
     # --- modes ---
     def train(self, mode: bool = True) -> "Module":
+        changed = False
         for m in self.modules():
-            object.__setattr__(m, "training", mode)
+            if m.training != mode:
+                object.__setattr__(m, "training", mode)
+                changed = True
+        if changed:
+            # mode tuple is epoch-cached (TrainStep._sync_mode); the torch
+            # idiom of re-asserting train() every iteration must stay a no-op
+            _bump_structure_epoch()
         return self
 
     def eval(self) -> "Module":
@@ -214,7 +363,13 @@ def functional_params(module: Module, param_map: dict):
     given values — the tracing-time analog of the reference's ThunderModule
     overrides (thunder/core/module.py:30). Buffers must be swapped too so
     mutable state (running stats) enters the trace as an input, not a baked
-    constant."""
+    constant.
+
+    The swap writes bypass the epoch-instrumented store (dict.__setitem__
+    directly): the context is a balanced swap-and-restore scoped to one
+    trace, so the tree's structure is unchanged once it exits, and bumping
+    would invalidate epoch-cached splits (TrainStep) on every first-call
+    trace — forcing a spurious re-walk on the step after any compile."""
     saved = []
     saved_buf = []
     for mod_name, mod in module.named_modules():
@@ -222,19 +377,19 @@ def functional_params(module: Module, param_map: dict):
             q = f"{mod_name}.{p_name}" if mod_name else p_name
             if q in param_map:
                 saved.append((mod, p_name, mod._parameters[p_name]))
-                mod._parameters[p_name] = param_map[q]
+                dict.__setitem__(mod._parameters, p_name, param_map[q])
         for b_name in list(mod._buffers):
             q = f"{mod_name}.{b_name}" if mod_name else b_name
             if q in param_map:
                 saved_buf.append((mod, b_name, mod._buffers[b_name]))
-                mod._buffers[b_name] = param_map[q]
+                dict.__setitem__(mod._buffers, b_name, param_map[q])
     try:
         yield
     finally:
         for mod, p_name, orig in saved:
-            mod._parameters[p_name] = orig
+            dict.__setitem__(mod._parameters, p_name, orig)
         for mod, b_name, orig in saved_buf:
-            mod._buffers[b_name] = orig
+            dict.__setitem__(mod._buffers, b_name, orig)
 
 
 class ThunderModule:
@@ -253,7 +408,7 @@ class ThunderModule:
                 f"cache={cache!r} is not supported for modules "
                 f"(supported: 'constant values', 'no caching')")
         self._module = module
-        self._overrides: dict = {}
+        self._overrides: dict = _EpochDict()
 
         def _traced(params: dict, args: tuple, kwargs: dict):
             with functional_params(module, params):
@@ -308,7 +463,7 @@ class ThunderModule:
 
     def set_override(self, name: str, param: Parameter) -> None:
         """Install a parameter override (sharded/quantized replacement)."""
-        self._overrides[name] = param
+        self._overrides[name] = param  # epoch-instrumented store bumps
 
     def __call__(self, *args, **kwargs):
         return self._cfn({**self.get_parameters(), **self.get_buffers()}, args, kwargs)

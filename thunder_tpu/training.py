@@ -10,12 +10,14 @@ donation on params/optimizer state. XLA then schedules the whole step with
 one dispatch and no host round-trips."""
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
-from .nn.module import Module, ThunderModule
+from .nn.module import Module, ThunderModule, structure_epoch
 from .observability import events as _obs
 from .observability import metrics as _obs_metrics
 from .observability import runtime as _obs_runtime
@@ -67,6 +69,9 @@ def _aot_fallback_errors() -> tuple:
 
 
 _AOT_FALLBACK_ERRORS = _aot_fallback_errors()
+
+# shared reusable no-op span for disabled-observability hot paths
+_NULL_SPAN = contextlib.nullcontext()
 
 
 class _CompiledWithFallback:
@@ -123,6 +128,12 @@ class TrainStep:
         self._jitted: Optional[Callable] = None
         self.opt_state = None
         self._step_count = 0
+        # steady-state dispatch fast path: the param split (an O(model) tree
+        # walk + requires_grad filter) is cached under the module structure
+        # epoch; _split_walks counts full walks for regression tests
+        self._split_cache = None
+        self._split_walks = 0
+        self._mode_epoch = None
         # built programs are mode-specific (train/eval flips change the traced
         # program — BatchNorm/Dropout branches — without changing any input
         # metadata); key the whole compiled-program set on the module-mode
@@ -144,9 +155,19 @@ class TrainStep:
         return extra() if extra is not None else None
 
     def _sync_mode(self):
+        # train()/eval() (and any structural mutation) bump the module
+        # structure epoch, so an unchanged epoch proves the mode tuple is
+        # unchanged — steady state skips the O(model) mode-tuple walk
+        epoch = structure_epoch()
+        if epoch == self._mode_epoch:
+            return
         key = self._mode_key()
         if key == self._active_mode:
+            self._mode_epoch = epoch
             return
+        # consume the epoch only AFTER the swap succeeds: if the error below
+        # raises, the next call must re-check and raise again rather than
+        # early-return and silently run the stale-mode program
         if self._grad_acc is not None:
             raise RuntimeError(
                 "module train/eval mode changed in the middle of a no_sync "
@@ -158,6 +179,7 @@ class TrainStep:
         for a, v in stash.items():
             setattr(self, a, v)
         self._active_mode = key
+        self._mode_epoch = epoch
 
     def _make_vag(self, *, sync_loss: bool = True):
         """Build a ThunderValueAndGrad over the (optionally distributed)
@@ -322,6 +344,7 @@ class TrainStep:
         self._jitted = _CompiledWithFallback(compiled, lambda: jit_fn)
 
     def _split_params(self):
+        self._split_walks += 1
         params = self.tmodule.get_parameters()
         trainable = {k: p for k, p in params.items() if getattr(p, "requires_grad", True)}
         frozen = {k: p for k, p in params.items() if k not in trainable}
@@ -332,20 +355,61 @@ class TrainStep:
             frozen.update(getb())
         return trainable, frozen
 
+    def _split_arrays(self):
+        """(tparam_arrays, frozen_arrays, trainable_pairs) with the split
+        STRUCTURE cached under the module structure epoch. Steady-state steps
+        do no module-tree walk and no requires_grad filtering — only direct
+        ``.data`` reads off cached Parameter references (params/buffer values
+        may change between steps; the key sets and grad partition cannot
+        without bumping the epoch). trainable_pairs is the write-back list
+        for ``new_params``."""
+        epoch = structure_epoch()
+        cache = self._split_cache
+        if cache is None or cache[0] != epoch:
+            params = self.tmodule.get_parameters()
+            self._split_walks += 1
+            t_pairs = tuple((k, p) for k, p in params.items()
+                            if getattr(p, "requires_grad", True))
+            tset = {k for k, _ in t_pairs}
+            f_pairs = tuple((k, p) for k, p in params.items() if k not in tset)
+            # buffers are re-read from their owning module each step: effect
+            # replay rebinds _buffers[name] to a NEW array, so caching the
+            # value (rather than the owner+name slot) would serve stale stats
+            b_triples = ()
+            if callable(getattr(self.tmodule, "get_buffers", None)):
+                b_triples = tuple(self.tmodule.module.named_buffer_slots())
+            cache = self._split_cache = (epoch, t_pairs, f_pairs, b_triples)
+        _, t_pairs, f_pairs, b_triples = cache
+        tparam_arrays = {k: p.data for k, p in t_pairs}
+        frozen_arrays = {k: getattr(p, "data", p) for k, p in f_pairs}
+        for k, m, bn in b_triples:
+            frozen_arrays[k] = m._buffers[bn]
+        return tparam_arrays, frozen_arrays, t_pairs
+
     def __call__(self, *args, **kwargs):
+        # one enabled() read gates ALL per-step observability: disabled mode
+        # (the default) must do zero event-bus work on the dispatch path
+        obs_on = _obs.enabled()
+        t_host = time.perf_counter_ns() if obs_on else 0
         self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
             return self.micro_step(*args, **kwargs)
-        trainable, frozen = self._split_params()
-        tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        tparam_arrays, frozen_arrays, t_pairs = self._split_arrays()
         if self.opt_state is None:
             self.opt_state = self.optimizer.init(tparam_arrays)
-        if self._jitted is None:
+        was_built = self._jitted is not None
+        if not was_built:
             if not self._try_aot(tparam_arrays, frozen_arrays, args, kwargs):
                 self._build(args, kwargs)
                 self._maybe_save_aot(tparam_arrays, frozen_arrays, args, kwargs)
         self.last_batch = (args, kwargs)  # for memory_analysis/harnesses
+        if obs_on and was_built:
+            # host dispatch overhead of a steady-state step: everything
+            # between call entry and handing off to the jitted program
+            # (mode check, cached split, array-dict build). Opt-in: with the
+            # bus disabled this whole block is one boolean test.
+            _obs.event("host_overhead", fn="train_step", step=self._step_count,
+                       us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
         if self._grad_acc is not None:
             # final (syncing) step of a no_sync accumulation window: fold the
             # accumulated local grads in before the optimizer update
@@ -359,15 +423,17 @@ class TrainStep:
             self._grad_acc = None
         else:
             # host-side step latency (opt-in; dispatch is async so this is
-            # submission latency unless the caller reads the loss value)
-            with _obs_runtime.step_span("train_step"):
+            # submission latency unless the caller reads the loss value).
+            # Gated on the obs_on read from call entry: the disabled-mode
+            # steady-state path must not call into the observability layer
+            with _obs_runtime.step_span("train_step") if obs_on else _NULL_SPAN:
                 loss, new_params, self.opt_state, effects = self._jitted(
                     tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
             if effects and getattr(self, "_effect_keys", None):
                 # epilogue: replay traced buffer mutations (running stats)
                 for (owner, name), v in zip(self._effect_keys, effects):
                     owner._buffers[name] = v
-        for k, p in trainable.items():
+        for k, p in t_pairs:
             p.data = new_params[k]
         self._step_count += 1
         return loss
@@ -390,9 +456,7 @@ class TrainStep:
         plan = getattr(self.tmodule, "_dist_plan", None)
         if plan is not None:
             return self._micro_step_dist(plan, args, kwargs)
-        trainable, frozen = self._split_params()
-        tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        tparam_arrays, frozen_arrays, _ = self._split_arrays()
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)
@@ -412,7 +476,7 @@ class TrainStep:
                 return loss, new_acc
 
             self._micro_jitted = jax.jit(micro, donate_argnums=(2,) if self.donate else ())
-        with _obs_runtime.step_span("micro_step"):
+        with _obs_runtime.step_span("micro_step") if _obs.enabled() else _NULL_SPAN:
             loss, self._grad_acc = self._micro_jitted(tparam_arrays, frozen_arrays, self._grad_acc, args, kwargs)
         return loss
 
@@ -450,9 +514,8 @@ class TrainStep:
         self._acc_mode = self._nosync_mode(plan)
         if self._acc_mode == "fsdp":
             return self._micro_step_fsdp(plan, args, kwargs)
-        trainable, frozen = self._split_params()
-        tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        # epoch-cached split: K micro-steps per window must not pay K walks
+        tparam_arrays, frozen_arrays, _ = self._split_arrays()
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)
@@ -546,9 +609,7 @@ class TrainStep:
         return self._gather_jitted(tparam_arrays, frozen_arrays)
 
     def _micro_step_fsdp(self, plan, args, kwargs):
-        trainable, frozen = self._split_params()
-        tparam_arrays = {k: p.data for k, p in trainable.items()}
-        frozen_arrays = {k: getattr(p, "data", p) for k, p in frozen.items()}
+        tparam_arrays, frozen_arrays, _ = self._split_arrays()
         if self._jitted is None:
             if self.opt_state is None:
                 self.opt_state = self.optimizer.init(tparam_arrays)

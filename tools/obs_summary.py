@@ -113,6 +113,26 @@ def recompile_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     return lines
 
 
+def host_overhead_stats(recs: list[dict]) -> list[str]:
+    """Per-dispatch host overhead (the opt-in ``host_overhead`` event emitted
+    by TrainStep and InterpretedFunction cache hits): how much Python runs
+    between step entry and the compiled-program handoff."""
+    by_fn: dict[str, list[float]] = {}
+    for r in recs:
+        if r.get("kind") == "event" and r.get("name") == "host_overhead":
+            attrs = r.get("attrs") or {}
+            if "us" in attrs:
+                by_fn.setdefault(attrs.get("fn", "?"), []).append(attrs["us"])
+    lines = []
+    for fn, durs in sorted(by_fn.items()):
+        durs.sort()
+        n = len(durs)
+        lines.append(f"  {fn:<20} dispatches={n}  mean={sum(durs) / n:.1f}us  "
+                     f"p50={durs[n // 2]:.1f}us  "
+                     f"p95={durs[min(n - 1, int(n * 0.95))]:.1f}us  max={durs[-1]:.1f}us")
+    return lines
+
+
 def step_stats(recs: list[dict]) -> list[str]:
     durs = sorted(r["dur_ms"] for r in recs
                   if r.get("kind") == "span" and r.get("name") in _STEP_SPANS)
@@ -140,6 +160,9 @@ def render(recs: list[dict], top: int = 0) -> str:
     steps = step_stats(recs)
     if steps:
         out += ["", "== step latency (host-side) ==", *steps]
+    host = host_overhead_stats(recs)
+    if host:
+        out += ["", "== host dispatch overhead ==", *host]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
