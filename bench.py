@@ -186,6 +186,32 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         if durs:
             host_overhead_us = round(sum(durs) / len(durs), 1)
 
+    # BENCH_OBS=1: capture a short profiled window after the timed loop and
+    # attribute device time to fusion regions — `mfu_measured` is model
+    # FLOPs over MEASURED device time (vs the analytic wall-clock `mfu`),
+    # and the breakdown names where the non-peak fraction goes. Best-effort:
+    # a profiler failure must never take the bench row down.
+    mfu_measured = None
+    device_breakdown = None
+    if obs_artifact:
+        try:
+            from thunder_tpu import observability
+
+            flops_per_step = _flops_per_token(cfg, T) * B * T
+            prof = observability.profile_steps(
+                lambda: float(step(idx, tgt)), n=3, warmup=1)
+            if prof is not None and prof.total_device_us:
+                mfu_measured = prof.mfu_measured(flops_per_step)
+                s = prof.summary_dict(flops_per_step)
+                device_breakdown = {k: s[k] for k in (
+                    "compute_us", "collective_us", "transfer_us",
+                    "unattributed_us", "attributed_frac")}
+                print(f"# device-time breakdown ({model_name}):", file=sys.stderr)
+                print("\n".join("# " + ln for ln in prof.table(top=12).splitlines()),
+                      file=sys.stderr)
+        except Exception as e:
+            print(f"# device profile failed ({model_name}): {e}", file=sys.stderr)
+
     return {
         "tps": tps,
         "loss": loss_val,
@@ -195,6 +221,8 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         "mem_gb": _mem_gb(step),
         "device_peak_gb": _device_peak_gb(),
         "host_overhead_us": host_overhead_us,
+        "mfu_measured": None if mfu_measured is None else round(mfu_measured, 4),
+        "device_breakdown": device_breakdown,
     }
 
 
@@ -286,7 +314,7 @@ def _bench_row(model_name: str, B: int, T: int, iters: int, ckpt: bool = False) 
 
     peak_gb = fused.get("device_peak_gb") or fused.get("mem_gb")
     extra = "+ckpt" if ckpt else ""
-    return {
+    row = {
         "metric": f"{model_name} pretrain tokens/sec/chip (B={B}, T={T}, fwd+bwd+adamw{extra}, "
                   f"vs hand-written jax.jit of the same model)",
         "value": round(fused_tps, 1),
@@ -299,6 +327,12 @@ def _bench_row(model_name: str, B: int, T: int, iters: int, ckpt: bool = False) 
         "compile_time_s": fused.get("compile_time_s"),
         "compile_time_warm_s": compile_time_warm_s,
     }
+    # measured-MFU columns ride only when the profiled window ran (BENCH_OBS=1)
+    if fused.get("mfu_measured") is not None:
+        row["mfu_measured"] = fused["mfu_measured"]
+    if fused.get("device_breakdown") is not None:
+        row["device_breakdown"] = fused["device_breakdown"]
+    return row
 
 
 def main():

@@ -1,0 +1,227 @@
+"""Step-time flight recorder (ISSUE 8): bounded ring + p50/p99, spike
+detection that cross-references recompile/data-stall events to name a
+cause, dump-on-crash, and the zero-work-when-disabled guarantee (in the
+counter-asserted style of test_dispatch_fastpath.py).
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import nn, observability, optim
+from thunder_tpu.observability import flight_recorder as fr
+from thunder_tpu.observability import metrics as obs_metrics
+from thunder_tpu.ops import ltorch
+from thunder_tpu.training import TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def obs_mem():
+    observability.reset()
+    fr.reset()
+    observability.enable()
+    yield
+    observability.disable()
+    observability.reset()
+    fr.reset()
+
+
+class _Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4, seed=0)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc(x), y)
+
+
+def _step_and_batch(rng):
+    net = _Net()
+    step = TrainStep(tt.jit(net), optim.AdamW(lr=0.05))
+    x = jnp.asarray(rng.rand(4, 8).astype(np.float32))
+    y = jnp.asarray(rng.rand(4, 4).astype(np.float32))
+    return net, step, x, y
+
+
+class TestRingAndStats:
+    def test_ring_is_bounded(self):
+        r = fr.FlightRecorder(capacity=16)
+        for i in range(100):
+            r.record_step(1.0, step=i)
+        recs = r.records()
+        assert len(recs) == 16
+        assert recs[-1]["step"] == 99
+
+    def test_stats_percentiles(self):
+        r = fr.FlightRecorder()
+        for ms in [1.0] * 98 + [2.0, 100.0]:
+            r.record_step(ms)
+        st = r.stats()
+        assert st["count"] == 100
+        assert st["p50_ms"] == 1.0
+        assert st["p99_ms"] == 100.0
+        assert st["max_ms"] == 100.0
+
+    def test_stats_empty(self):
+        assert fr.FlightRecorder().stats() is None
+
+    def test_dump_and_snapshot(self, tmp_path):
+        r = fr.FlightRecorder()
+        for i in range(10):
+            r.record_step(1.0 + i, step=i)
+        path = r.dump(str(tmp_path / "flight.json"))
+        data = json.load(open(path))
+        assert data["stats"]["count"] == 10
+        assert len(data["steps"]) == 10
+
+
+class TestSpikeDetection:
+    def test_uniform_steps_no_spikes(self, obs_mem):
+        r = fr.FlightRecorder()
+        for _ in range(50):
+            assert r.record_step(5.0) is None
+        assert r.spikes == 0
+
+    def test_sub_ms_jitter_ignored(self, obs_mem):
+        r = fr.FlightRecorder()
+        for _ in range(20):
+            r.record_step(0.01)
+        assert r.record_step(0.5) is None  # 50x median but under SPIKE_MIN_MS
+
+    def test_spike_names_recompile_cause(self, obs_mem):
+        r = fr.FlightRecorder()
+        for _ in range(20):
+            r.record_step(2.0)
+        obs_metrics.record_recompile(obs_metrics.REASON_SHAPE_CHANGE, fn="f")
+        spike = r.record_step(50.0)
+        assert spike is not None
+        assert spike["cause"] == "recompile"
+        assert spike["reason"] == "shape-change"
+        evs = [rec for rec in observability.records()
+               if rec["kind"] == "event" and rec["name"] == "step_spike"]
+        assert evs and evs[-1]["attrs"]["cause"] == "recompile"
+        assert observability.counters().get("flight.spikes") == 1
+
+    def test_spike_names_data_stall_cause(self, obs_mem):
+        r = fr.FlightRecorder()
+        for _ in range(20):
+            r.record_step(2.0)
+        observability.event("data_stall", ms=31.0)
+        spike = r.record_step(40.0)
+        assert spike is not None
+        assert spike["cause"] == "data-stall"
+
+    def test_injected_recompile_mid_run_spikes_through_trainstep(self, obs_mem, rng):
+        """The acceptance scenario: a recompile injected mid-run makes the
+        flight recorder fire a spike event naming `recompile` as the cause."""
+        net, step, x, y = _step_and_batch(rng)
+        for _ in range(12):
+            float(step(x, y))
+        assert fr.stats()["count"] == 12
+        # deliberately inject a recompile: drop the built program so the
+        # next step pays trace + lower + XLA compile mid-run
+        step._jitted = None
+        float(step(x, y))
+        evs = [rec for rec in observability.records()
+               if rec["kind"] == "event" and rec["name"] == "step_spike"]
+        assert evs, "mid-run recompile did not fire a spike event"
+        attrs = evs[-1]["attrs"]
+        assert attrs["cause"] == "recompile"
+        assert attrs["ratio"] > fr.SPIKE_FACTOR
+        # and a reason-coded recompile event was recorded for the rebuild
+        recompiles = [rec for rec in observability.records()
+                      if rec["kind"] == "event" and rec["name"] == "recompile"]
+        assert any(rec["attrs"].get("fn") == "train_step" for rec in recompiles)
+
+    def test_spikes_render_in_cli(self, obs_mem, tmp_path):
+        r = fr.FlightRecorder()
+        for _ in range(20):
+            r.record_step(2.0)
+        obs_metrics.record_recompile(obs_metrics.REASON_CACHE_MISS, fn="f")
+        r.record_step(60.0)
+        shard = str(tmp_path / "t.jsonl")
+        observability.dump(shard)
+        spec = importlib.util.spec_from_file_location(
+            "obs_summary", os.path.join(REPO, "tools", "obs_summary.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = mod.render(mod.load_many([shard]))
+        assert "step spikes (flight recorder)" in out
+        assert "cause=recompile" in out
+
+
+class TestCrashHook:
+    def test_crash_hook_dumps_ring(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TT_FLIGHT_FILE", str(tmp_path / "crash.json"))
+        r = fr.recorder()
+        r.reset()
+        r.record_step(1.0)
+        fr.install_crash_hook()
+        try:
+            seen = []
+            fr._prev_excepthook = lambda *a: seen.append(a)
+            fr._crash_hook(ValueError, ValueError("boom"), None)
+            assert (tmp_path / "crash.json").exists()
+            assert seen, "previous excepthook was not chained"
+        finally:
+            fr.uninstall_crash_hook()
+            r.reset()
+
+    def test_install_is_idempotent(self):
+        fr.install_crash_hook()
+        hook = sys.excepthook
+        fr.install_crash_hook()
+        assert sys.excepthook is hook
+        fr.uninstall_crash_hook()
+
+
+class TestDisabledZeroWork:
+    def test_disabled_step_path_never_touches_recorder(self, rng, monkeypatch):
+        """Counter-asserted (test_dispatch_fastpath.py style): with the bus
+        disabled, the flight-recorder/profiler additions contribute zero
+        work to the steady-state train-step hot path."""
+        net, step, x, y = _step_and_batch(rng)
+        float(step(x, y))
+        float(step(x, y))
+        assert not observability.enabled()
+
+        def boom(*a, **k):
+            raise AssertionError("flight recorder touched on the disabled hot path")
+
+        from thunder_tpu import training as T
+        from thunder_tpu.observability import events as ev, runtime as rt
+
+        monkeypatch.setattr(T._obs_flight, "record_step", boom)
+        monkeypatch.setattr(T._obs_flight._RECORDER, "record_step", boom)
+        monkeypatch.setattr(rt, "step_sampled", boom)
+        monkeypatch.setattr(ev, "event", boom)
+        monkeypatch.setattr(ev, "inc", boom)
+        float(step(x, y))
+
+    def test_disabled_inference_path_zero_work(self, monkeypatch):
+        from thunder_tpu import inference as inf
+
+        assert not observability.enabled()
+
+        def boom(*a, **k):
+            raise AssertionError("observability touched with the bus disabled")
+
+        monkeypatch.setattr(inf._obs_flight, "record_step", boom)
+        monkeypatch.setattr(inf._obs_runtime, "step_span", boom)
+        monkeypatch.setattr(inf._obs_runtime, "annotate_call", boom)
+        # generate() reads enabled() once; with the bus off none of the
+        # patched entry points may run. The tiny config keeps it fast.
+        from thunder_tpu.inference import GPTInference
+        from thunder_tpu.models.litgpt import Config, GPT
+
+        cfg = Config.from_name("tiny", block_size=32)
+        eng = GPTInference(GPT(cfg, dtype=jnp.float32), dtype=jnp.float32)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        eng.generate(prompt, max_new_tokens=2, scan_decode=False)

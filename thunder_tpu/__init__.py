@@ -379,11 +379,11 @@ class ThunderCompiledFunction(EpilogueMixin):
     # -- introspection (reference thunder/__init__.py:944-1106) --
     @property
     def cache_hits(self):
-        return self._cs.cache_hits
+        return int(self._cs.cache_hits)
 
     @property
     def cache_misses(self):
-        return self._cs.cache_misses
+        return int(self._cs.cache_misses)
 
 
 def jit(
@@ -518,11 +518,11 @@ def print_last_interpreter_log(cfn, limit: int = 200) -> None:
 
 
 def cache_hits(cfn) -> int:
-    return _get_cs(cfn).cache_hits
+    return int(_get_cs(cfn).cache_hits)
 
 
 def cache_misses(cfn) -> int:
-    return _get_cs(cfn).cache_misses
+    return int(_get_cs(cfn).cache_misses)
 
 
 def compile_stats(cfn) -> CompileStats:

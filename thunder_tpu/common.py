@@ -12,9 +12,13 @@ class CompileStats:
     """Per-compile timings and cache counters (reference thunder/common.py:65)."""
 
     def __init__(self):
-        self.cache_hits = 0
-        self.cache_misses = 0
-        self.calls = 0
+        from .observability.metrics import AtomicCounter
+
+        # atomic: concurrent inference threads share one compiled function,
+        # and `cs.cache_hits += 1` on a plain int is a lost-update race
+        self.cache_hits = AtomicCounter()
+        self.cache_misses = AtomicCounter()
+        self.calls = AtomicCounter()
         self.last_trace_tracing_time_ns = 0
         self.last_trace_transform_time_ns = 0
         self.last_compile_time_ns = 0

@@ -80,6 +80,7 @@ class Symbol(SymbolInterface):
         module: str | None = None,
         tags: Sequence[str] = (),
         print_override: Callable | None = None,
+        cost_fn: Callable | None = None,
         _bind_postprocess: Callable | None = None,
     ):
         self.name = name
@@ -91,6 +92,11 @@ class Symbol(SymbolInterface):
         self.module = module
         self.tags = frozenset(tags)
         self.print_override = print_override
+        # cost annotation: (bsym) -> {"flops": float, "bytes": int},
+        # overriding observability/flops.py's generic model — executors with
+        # nonstandard kernels (flash attention recompute, fp8 scaling) price
+        # themselves here
+        self.cost_fn = cost_fn
         self._bind_postprocess = _bind_postprocess
 
     def __repr__(self) -> str:
@@ -173,6 +179,16 @@ class BoundSymbol:
                 return id(x)
 
         return (self.sym.id, freeze(self.args), freeze(self.kwargs))
+
+    def cost(self) -> dict:
+        """{"flops", "bytes"} for this bound op — the symbol's ``cost_fn``
+        annotation when present, else the observability/flops.py model
+        (fusion regions aggregate over subsymbols with interface bytes)."""
+        from ..observability import flops as _flops
+
+        if self.subsymbols and self.sym.executor is not None:
+            return _flops.fusion_cost(self)
+        return _flops.bsym_cost(self)
 
     def with_impl(self, impl, executor=None) -> "BoundSymbol":
         b = BoundSymbol(self.sym, self.args, self.kwargs, self.output, subsymbols=self.subsymbols, impl=impl,

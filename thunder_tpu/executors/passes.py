@@ -94,6 +94,14 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
                 sp.set(regions=len(regions))
             _obs_metrics.record_fusion(ex.name, len(regions),
                                        sum(len(b.subsymbols) for b in regions))
+
+    # region-name <-> symbol registry: every fusion region formed above is
+    # registered (name -> member bsym ids + flops/bytes cost) so device
+    # profiles (observability/profiler.py) can join measured device time
+    # back to the trace symbols the region was built from
+    from ..observability import profiler as _obs_profiler
+
+    _obs_profiler.register_trace_regions(claimed)
     # eager frees for op-by-op execution (reference passes.py:261); fused
     # regions don't need it but the DELs between them are harmless
     from ..core.transform_common import del_last_used

@@ -114,6 +114,12 @@ class XLAFusionExecutor(FusionExecutor):
             with _obs_runtime.fusion_scope(name):
                 return raw_fn(*args)
 
+        # the jitted module is named after the wrapped callable
+        # ("jit_xla_fusion_N"): device trace events carry it in
+        # args.hlo_module, which is the profiler's primary join back to
+        # this region — it works even on backends (CPU) whose per-op
+        # events drop the named_scope metadata
+        scoped_fn.__name__ = name
         jfn = jax.jit(scoped_fn)
 
         fusion_sym = Symbol(name, None, id=f"xla.{name}", is_prim=True, executor=self, module="xla")

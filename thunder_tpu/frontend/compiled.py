@@ -17,6 +17,7 @@ from ..core.pytree import tree_flatten
 from ..core.transform_common import dce
 from ..observability import events as _obs
 from ..observability import metrics as _obs_metrics
+from ..observability import runtime as _obs_runtime
 from ..observability.events import key_digest as _key_digest
 from .jit_ext import _is_tensor_like, _unwrap_param, general_jit
 
@@ -203,8 +204,11 @@ class InterpretedFunction:
             flat_inputs = entry.prologue_fn(*tensor_leaves)
             if obs_on:
                 _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
-                _obs.event("host_overhead", fn=self.__name__,
-                           us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
+                # host_overhead is per-dispatch; TT_OBS_SAMPLE bounds its
+                # volume on serving hot loops (counters stay exact)
+                if _obs_runtime.step_sampled(self.__name__):
+                    _obs.event("host_overhead", fn=self.__name__,
+                               us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
             return entry.computation_fn(*flat_inputs)
         shape_key = self._shape_key(leaves, mask)
         if self.cache_option == "symbolic values":
@@ -247,8 +251,9 @@ class InterpretedFunction:
                 cs.cache_hits += 1
                 if obs_on:
                     _obs_metrics.record_cache("trace", "hit", fn=self.__name__)
-                    _obs.event("host_overhead", fn=self.__name__,
-                               us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
+                    if _obs_runtime.step_sampled(self.__name__):
+                        _obs.event("host_overhead", fn=self.__name__,
+                                   us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
                 return entry.computation_fn(*flat_inputs)
         cs.cache_misses += 1
         if obs_on:
@@ -264,8 +269,8 @@ class InterpretedFunction:
 
     @property
     def cache_hits(self):
-        return self._cs.cache_hits
+        return int(self._cs.cache_hits)
 
     @property
     def cache_misses(self):
-        return self._cs.cache_misses
+        return int(self._cs.cache_misses)

@@ -17,11 +17,18 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+_NULL = contextlib.nullcontext()
+
 from . import nn
+from .observability import events as _obs
+from .observability import flight_recorder as _obs_flight
+from .observability import runtime as _obs_runtime
 from .ops import clang, ltorch
 
 
@@ -215,11 +222,17 @@ class GPTInference:
         cache = KVCache(cfg.n_layer, B, cfg.n_query_groups, self.max_seq, cfg.head_size, self.dtype)
         ks, vs = cache.as_tuple()
 
+        # one enabled() read gates the per-request observability (span +
+        # flight-recorder records); disabled mode adds zero work here
+        obs_on = _obs.enabled()
         t_start = time.perf_counter()
-        logits, ks, vs = self._prefill_cfn(params, prompt, ks, vs)
-        next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
-        jax.block_until_ready(next_tok)
+        with _obs_runtime.step_span("infer_prefill", B=B, T=T) if obs_on else _NULL:
+            logits, ks, vs = self._prefill_cfn(params, prompt, ks, vs)
+            next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+            jax.block_until_ready(next_tok)
         ttft = time.perf_counter() - t_start
+        if obs_on:
+            _obs_flight.record_step(ttft * 1e3, fn="infer_prefill", B=B, T=T)
 
         n_steps = max_new_tokens - 1
         use_scan = scan_decode and temperature == 0.0 and n_steps > 0
@@ -235,9 +248,15 @@ class GPTInference:
                 self._decode_cfn(params, next_tok[:, None], ks, vs, jnp.asarray(T, jnp.int32))
                 self._build_scan_decode(n_steps)
                 self._scan_sig = sig
-            toks_scan, ks, vs = self._scan_jitted(params, next_tok, ks, vs, T)
-            jax.block_until_ready(toks_scan)
+            with _obs_runtime.annotate_call("tt_decode") if obs_on else _NULL:
+                toks_scan, ks, vs = self._scan_jitted(params, next_tok, ks, vs, T)
+                jax.block_until_ready(toks_scan)
             dt = time.perf_counter() - t_decode
+            if obs_on:
+                # one record per generation: the scan is ONE dispatch, so
+                # per-token wall time is the window divided by its length
+                _obs_flight.record_step(dt * 1e3, fn="infer_decode",
+                                        n_tokens=n_steps, scan=True)
             out = jnp.concatenate([prompt, next_tok[:, None], toks_scan.T.astype(prompt.dtype)], axis=1)
             metrics = GenerationMetrics(
                 ttft_s=ttft,
@@ -262,6 +281,9 @@ class GPTInference:
                 pos += 1
             jax.block_until_ready(next_tok)
             dt = time.perf_counter() - t_decode
+            if obs_on:
+                _obs_flight.record_step(dt * 1e3, fn="infer_decode",
+                                        n_tokens=n_steps, scan=False)
 
         out = jnp.concatenate([prompt] + [t[:, None] for t in toks], axis=1)
         metrics = GenerationMetrics(

@@ -41,7 +41,29 @@ from .metrics import (  # noqa: F401
     record_fusion,
     record_recompile,
 )
-from .runtime import StepTimer, annotate_call, fusion_scope, step_span  # noqa: F401
+from .runtime import (  # noqa: F401
+    StepTimer,
+    annotate_call,
+    fusion_scope,
+    sample_rate,
+    set_sample_rate,
+    step_sampled,
+    step_span,
+)
+from . import flight_recorder  # noqa: F401
+from . import flops  # noqa: F401
+from . import profiler  # noqa: F401
+from .flight_recorder import install_crash_hook  # noqa: F401
+from .profiler import (  # noqa: F401
+    DeviceProfile,
+    attribute,
+    profile,
+    profile_steps,
+    region_info,
+    regions,
+    register_region,
+    resolve,
+)
 
 
 def last_compile_report(cfn) -> dict | None:

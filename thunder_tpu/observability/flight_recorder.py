@@ -1,0 +1,232 @@
+"""Step-time flight recorder: bounded ring of per-step timings + spike triage.
+
+A fleet doesn't read profiles; it reads "step 4183 took 9.4× the median,
+probably a recompile". This module keeps a bounded ring buffer of per-step
+wall (and, when a profile window measured it, device) timings, computes
+p50/p99 without re-parsing JSONL, detects stragglers/spikes against a
+rolling median, and cross-references the event bus's recent records —
+reason-coded ``recompile`` events, ``host_overhead`` outliers,
+``data_stall`` / prefetch waits — to name a likely cause on the spike
+event it emits.
+
+Strictly opt-in on the hot path: with the bus disabled ``record_step`` is
+never called (training.py gates it behind the same single ``enabled()``
+read as every other per-step touch). A dump-on-crash hook
+(``install_crash_hook``) writes the ring to disk when the process dies
+with an exception, and utils/report.py attaches the same dump to repro
+bundles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from collections import deque
+from typing import Optional
+
+from . import events as _obs
+
+SPIKE_FACTOR = 3.0       # step > factor × rolling median → spike
+SPIKE_MIN_SAMPLES = 8    # need a median before calling anything a spike
+SPIKE_MIN_MS = 1.0       # ignore sub-ms jitter entirely
+_CAUSE_WINDOW_RECORDS = 64  # bus records scanned backwards for a cause
+
+
+class FlightRecorder:
+    """Bounded ring of per-step timing records with spike detection."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._durs: deque = deque(maxlen=256)  # rolling window for the median
+        self.spikes = 0
+        self._step = 0
+
+    def record_step(self, wall_ms: float, *, step: Optional[int] = None,
+                    device_ms: Optional[float] = None, fn: str = "step",
+                    **attrs) -> Optional[dict]:
+        """Append one step; returns the spike record if this step spiked."""
+        with self._lock:
+            self._step += 1
+            rec = {
+                "step": self._step if step is None else step,
+                "wall_ms": round(wall_ms, 3),
+                "ts_ms": round(_obs._BUS.now_ms(), 3),
+                "fn": fn,
+            }
+            if device_ms is not None:
+                rec["device_ms"] = round(device_ms, 3)
+            if attrs:
+                rec["attrs"] = attrs
+            median = self._median_locked()
+            self._ring.append(rec)
+            self._durs.append(wall_ms)
+        spike = None
+        if (median is not None and wall_ms >= SPIKE_MIN_MS
+                and wall_ms > SPIKE_FACTOR * median):
+            cause, detail = self._likely_cause()
+            spike = {
+                "step": rec["step"], "wall_ms": rec["wall_ms"],
+                "median_ms": round(median, 3),
+                "ratio": round(wall_ms / median, 2) if median else None,
+                "cause": cause, "fn": fn, **detail,
+            }
+            rec["spike"] = spike
+            with self._lock:
+                self.spikes += 1
+            _obs.event("step_spike", **spike)
+            _obs.inc("flight.spikes")
+        return spike
+
+    def _median_locked(self) -> Optional[float]:
+        if len(self._durs) < SPIKE_MIN_SAMPLES:
+            return None
+        xs = sorted(self._durs)
+        return xs[len(xs) // 2]
+
+    def _likely_cause(self) -> tuple[str, dict]:
+        """Scan the bus's most recent records for the event that explains a
+        slow step. Priority: a recompile (reason-coded, the usual killer) →
+        a data stall (prefetch underrun) → an outsized host_overhead →
+        unknown."""
+        # the public accessor copies under the bus lock; iterating the live
+        # deque would race concurrent emitters (safe only by GIL accident)
+        recent = _obs.records()[-_CAUSE_WINDOW_RECORDS:]
+        host_us = [r["attrs"].get("us", 0.0) for r in recent
+                   if r.get("kind") == "event" and r.get("name") == "host_overhead"]
+        for r in reversed(recent):
+            if r.get("kind") != "event":
+                continue
+            name = r.get("name")
+            if name == "recompile":
+                return "recompile", {"reason": (r.get("attrs") or {}).get("reason")}
+            if name in ("data_stall", "prefetch_stall"):
+                return "data-stall", {"stall_ms": (r.get("attrs") or {}).get("ms")}
+        if len(host_us) >= 2 and host_us[-1] > 5.0 * (sorted(host_us)[len(host_us) // 2] or 1.0):
+            return "host-overhead", {"host_us": host_us[-1]}
+        return "unknown", {}
+
+    # -- read side --
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def stats(self) -> Optional[dict]:
+        with self._lock:
+            durs = sorted(r["wall_ms"] for r in self._ring)
+        if not durs:
+            return None
+        n = len(durs)
+
+        def q(p: float) -> float:
+            return durs[min(n - 1, int(n * p))]
+
+        out = {
+            "count": n,
+            "mean_ms": round(sum(durs) / n, 3),
+            "p50_ms": round(q(0.50), 3),
+            "p90_ms": round(q(0.90), 3),
+            "p99_ms": round(q(0.99), 3),
+            "max_ms": round(durs[-1], 3),
+            "spikes": self.spikes,
+        }
+        dev = [r["device_ms"] for r in self.records() if "device_ms" in r]
+        if dev:
+            out["device_p50_ms"] = round(sorted(dev)[len(dev) // 2], 3)
+        return out
+
+    def annotate_device_time(self, device_ms_per_step: float, last_n: int) -> None:
+        """Back-fill measured device time onto the trailing steps (called
+        after a profile_steps window measured the real number)."""
+        with self._lock:
+            for rec in list(self._ring)[-last_n:]:
+                rec["device_ms"] = round(device_ms_per_step, 3)
+
+    def snapshot(self) -> dict:
+        return {"stats": self.stats(), "steps": self.records()}
+
+    def dump(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=1)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._durs.clear()
+            self.spikes = 0
+            self._step = 0
+
+
+# process-global recorder: training/inference record into it when the bus
+# is enabled; repro bundles and the crash hook read it
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record_step(wall_ms: float, **kw) -> Optional[dict]:
+    return _RECORDER.record_step(wall_ms, **kw)
+
+
+def stats() -> Optional[dict]:
+    return _RECORDER.stats()
+
+
+def reset() -> None:
+    _RECORDER.reset()
+
+
+# -- dump on crash ----------------------------------------------------------
+
+_prev_excepthook = None
+_hook_installed = False
+
+
+def _crash_hook(exc_type, exc, tb):
+    try:
+        if _RECORDER.records():
+            path = os.environ.get(
+                "TT_FLIGHT_FILE",
+                os.path.join(tempfile_dir(), f"tt_flight_{os.getpid()}.json"))
+            _RECORDER.dump(path)
+            print(f"# thunder_tpu flight recorder: {len(_RECORDER.records())} "
+                  f"steps dumped to {path}", file=sys.stderr)
+    except Exception:
+        pass
+    if _prev_excepthook is not None:
+        _prev_excepthook(exc_type, exc, tb)
+
+
+def tempfile_dir() -> str:
+    import tempfile
+
+    return tempfile.gettempdir()
+
+
+def install_crash_hook() -> None:
+    """Chain onto sys.excepthook: an uncaught exception dumps the ring to
+    ``TT_FLIGHT_FILE`` (default: <tmp>/tt_flight_<pid>.json) so post-mortem
+    triage has the step-time history that led to the crash. Idempotent."""
+    global _prev_excepthook, _hook_installed
+    if _hook_installed:
+        return
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _crash_hook
+    _hook_installed = True
+
+
+def uninstall_crash_hook() -> None:
+    global _prev_excepthook, _hook_installed
+    if not _hook_installed:
+        return
+    sys.excepthook = _prev_excepthook or sys.__excepthook__
+    _prev_excepthook = None
+    _hook_installed = False

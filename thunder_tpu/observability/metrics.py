@@ -16,10 +16,92 @@ Counter naming: ``<cache>.<hit|miss|evict>`` for cache traffic (caches:
 ``trace`` — the per-function specialization cache, ``aot`` — the serialized
 whole-step executable cache), ``recompile.<reason>`` for recompiles,
 ``fusion.regions`` / ``fusion.ops`` for fusion formation.
+
+Thread-safety: the bus counters (``events.inc``) read-modify-write under
+the bus lock, so every path through this module is already atomic under
+concurrent inference threads. The per-function CompileStats counters
+(common.py) were NOT — plain-int ``+=`` loses updates — and now use
+``AtomicCounter`` below (tests/test_observability.py TestAtomicCounters).
 """
 from __future__ import annotations
 
+import threading
+
 from . import events
+
+
+class AtomicCounter:
+    """An int-like counter whose ``+= n`` is atomic under concurrent
+    threads.
+
+    The bus's own counters (``events.inc``) already mutate under the bus
+    lock, but the per-function CompileStats counters (cache_hits/misses/
+    calls in common.py) were plain ints — ``cs.cache_hits += 1`` is a
+    read-modify-write that loses updates when concurrent inference threads
+    share one compiled function. This type keeps those call sites
+    unchanged: ``+=`` routes through ``__iadd__``, which mutates in place
+    under a lock and returns self (the attribute re-assignment rebinds the
+    same object). Reads compare/convert like an int."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self, value: int = 0):
+        self._value = int(value)
+        self._lock = threading.Lock()
+
+    def __iadd__(self, other: int) -> "AtomicCounter":
+        with self._lock:
+            self._value += int(other)
+        return self
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def __int__(self) -> int:
+        return self._value
+
+    def __index__(self) -> int:
+        return self._value
+
+    def __bool__(self) -> bool:
+        return bool(self._value)
+
+    def __add__(self, other):
+        return self._value + int(other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._value - int(other)
+
+    def __rsub__(self, other):
+        return int(other) - self._value
+
+    def __eq__(self, other):
+        return self._value == int(other) if isinstance(other, (int, AtomicCounter)) else NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __lt__(self, other):
+        return self._value < int(other)
+
+    def __le__(self, other):
+        return self._value <= int(other)
+
+    def __gt__(self, other):
+        return self._value > int(other)
+
+    def __ge__(self, other):
+        return self._value >= int(other)
+
+    def __hash__(self):
+        return hash(self._value)
+
+    def __repr__(self) -> str:
+        return repr(self._value)
 
 REASON_CACHE_MISS = "cache-miss"
 REASON_SHAPE_CHANGE = "shape-change"

@@ -1,0 +1,462 @@
+"""Device-time capture and attribution: jax.profiler → per-region breakdown.
+
+The host side of the pipeline is already legible (events.py spans); this
+module makes the *device* side legible. ``profile_steps`` wraps
+``jax.profiler.trace`` around N step calls, parses the captured
+trace-event stream (the perfetto JSON export — stdlib-parseable, available
+on CPU and TPU), and joins device durations back to trace symbols through
+the **region registry**: every fusion region the executor passes form is
+registered here as ``name → {bsym ids, flops, bytes}``, and the region
+name reaches the device events two ways —
+
+  * the region's jitted callable is named after it (executors/xlaex.py
+    sets ``__name__ = "xla_fusion_N"``), so its HLO module is
+    ``jit_xla_fusion_N`` and every device event carries that in
+    ``args.hlo_module`` (the join that works even on the CPU backend);
+  * the region's computation is traced under ``jax.named_scope(name)``,
+    so on TPU the op metadata (``tf_op``/``long_name``/scope paths)
+    carries the name even when regions are inlined into one whole-step
+    program (TrainStep).
+
+The result is a ``DeviceProfile``: per-region device time split into
+compute / collective / transfer, model FLOPs/bytes per region (the
+observability/flops.py cost model), arithmetic intensity, a roofline tag,
+and measured MFU. ``emit()`` writes it onto the event bus so JSONL shards
+carry it for ``tools/obs_summary.py perf``.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from . import events as _obs
+from . import flops as _flops
+
+# ---------------------------------------------------------------------------
+# region registry: fusion-region name <-> trace symbols (+ cost annotations)
+# ---------------------------------------------------------------------------
+
+_REGISTRY_LOCK = threading.Lock()
+_REGIONS: dict[str, dict] = {}
+
+
+def register_region(name: str, *, bsym_ids: Iterable = (), executor: str = "",
+                    flops: float = 0.0, bytes: int = 0, kind: str = "compute",
+                    level: int = 0) -> None:
+    """Register (or refresh) one fusion region / named program phase.
+
+    ``level`` is the attribution granularity: 0 = fusion region (finest),
+    1 = program phase (tt_fwd_bwd / tt_optimizer), 2 = whole program
+    (tt_train_step). When several registered names match one device event
+    (a TPU op carries its full scope path AND its enclosing jit module
+    name), the smallest level wins — time lands on the finest region that
+    claims it."""
+    info = {
+        "name": name,
+        "bsym_ids": [str(b) for b in bsym_ids],
+        "executor": executor,
+        "flops": float(flops),
+        "bytes": int(bytes),
+        "kind": kind,
+        "level": int(level),
+    }
+    with _REGISTRY_LOCK:
+        _REGIONS[name] = info
+
+
+def register_trace_regions(trace) -> int:
+    """Walk an execution trace and register every fusion-executor region
+    (any executor's — xla, pallas, ...) under its region name, with the
+    flops/bytes cost of its subsymbols. Called by executors/passes.py after
+    the fusion passes; returns the number of regions registered."""
+    n = 0
+    for bsym in getattr(trace, "bound_symbols", ()):
+        ex = getattr(bsym.sym, "executor", None)
+        if ex is None or not getattr(ex, "is_fusion_executor", lambda: False)():
+            continue
+        if not bsym.subsymbols:
+            continue
+        cost = _flops.fusion_cost(bsym)
+        register_region(
+            bsym.sym.name,
+            bsym_ids=[s.sym.name for s in bsym.subsymbols],
+            executor=getattr(ex, "name", ""),
+            flops=cost["flops"],
+            bytes=cost["bytes"],
+            kind="compute",
+        )
+        n += 1
+    return n
+
+
+def regions() -> dict[str, dict]:
+    with _REGISTRY_LOCK:
+        return {k: dict(v) for k, v in _REGIONS.items()}
+
+
+def region_info(name: str) -> Optional[dict]:
+    with _REGISTRY_LOCK:
+        info = _REGIONS.get(name)
+        return dict(info) if info is not None else None
+
+
+def resolve(name: str) -> list[str]:
+    """Region name → the BoundSymbol ids it was formed from (round-trip of
+    the jax.named_scope annotation; [] for unknown names)."""
+    info = region_info(name)
+    return list(info["bsym_ids"]) if info else []
+
+
+def clear_regions() -> None:
+    with _REGISTRY_LOCK:
+        _REGIONS.clear()
+
+
+# ---------------------------------------------------------------------------
+# trace-event capture + parsing
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_PAT = re.compile(
+    r"all-reduce|all_reduce|all-gather|all_gather|reduce-scatter|reduce_scatter|"
+    r"collective|all-to-all|psum|ppermute|permute", re.I)
+_TRANSFER_PAT = re.compile(
+    r"memcpy|copy-start|copy-done|infeed|outfeed|transfer|device_put|"
+    r"h2d|d2h|dma|send|recv", re.I)
+
+
+def _load_perfetto(log_dir: str) -> list[dict]:
+    """Newest perfetto/trace JSON (possibly .gz) under a profiler log dir."""
+    paths = sorted(
+        glob.glob(os.path.join(log_dir, "**", "*.json.gz"), recursive=True)
+        + glob.glob(os.path.join(log_dir, "**", "*.trace.json"), recursive=True),
+        key=os.path.getmtime)
+    # prefer the perfetto export; fall back to any trace json
+    pref = [p for p in paths if "perfetto" in os.path.basename(p)] or paths
+    if not pref:
+        return []
+    path = pref[-1]
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    evs = data if isinstance(data, list) else data.get("traceEvents", [])
+    return [e for e in evs if isinstance(e, dict)]
+
+
+@dataclass
+class RegionTime:
+    """Attributed device time for one region/bucket."""
+
+    name: str
+    us: float = 0.0
+    count: int = 0
+    category: str = "compute"  # compute | collective | transfer
+    cat_us: dict = field(default_factory=dict)  # per-category accumulation
+    bsym_ids: list = field(default_factory=list)
+    flops: float = 0.0
+    bytes: int = 0
+    intensity: Optional[float] = None
+    roofline: str = ""
+    mfu: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name, "us": round(self.us, 3), "count": self.count,
+            "category": self.category, "bsym_ids": self.bsym_ids,
+            "flops": self.flops, "bytes": self.bytes,
+            "intensity": None if self.intensity is None else round(self.intensity, 3),
+            "roofline": self.roofline,
+            "mfu": None if self.mfu is None else round(self.mfu, 4),
+        }
+
+
+@dataclass
+class DeviceProfile:
+    """Per-region device-time breakdown of a profiled window of steps."""
+
+    n_steps: int = 0
+    total_device_us: float = 0.0
+    regions: dict = field(default_factory=dict)  # name -> RegionTime
+    categories: dict = field(default_factory=dict)  # compute/collective/transfer -> us
+    unattributed_us: float = 0.0
+    wall_us: float = 0.0
+    peak_tflops: float = 0.0
+
+    @property
+    def attributed_us(self) -> float:
+        return self.total_device_us - self.unattributed_us
+
+    @property
+    def attributed_frac(self) -> Optional[float]:
+        if not self.total_device_us:
+            return None
+        return self.attributed_us / self.total_device_us
+
+    def mfu_measured(self, flops_per_step: Optional[float] = None) -> Optional[float]:
+        """Measured MFU over the window: model FLOPs / device-time × peak.
+        flops_per_step defaults to the cost-model sum over attributed
+        compute regions. Region flops are PER STEP (the registry prices one
+        execution of the region), while device time spans the whole
+        window — both paths must scale by n_steps."""
+        if flops_per_step is None:
+            total = sum(r.flops for r in self.regions.values()
+                        if r.category == "compute") * max(1, self.n_steps)
+        else:
+            total = flops_per_step * max(1, self.n_steps)
+        busy = self.categories.get("compute", 0.0) or self.total_device_us
+        return _flops.measured_mfu(total, busy, self.peak_tflops or None)
+
+    def summary_dict(self, flops_per_step: Optional[float] = None) -> dict:
+        return {
+            "n_steps": self.n_steps,
+            "total_device_us": round(self.total_device_us, 1),
+            "wall_us": round(self.wall_us, 1),
+            "compute_us": round(self.categories.get("compute", 0.0), 1),
+            "collective_us": round(self.categories.get("collective", 0.0), 1),
+            "transfer_us": round(self.categories.get("transfer", 0.0), 1),
+            "unattributed_us": round(self.unattributed_us, 1),
+            "attributed_frac": (None if self.attributed_frac is None
+                                else round(self.attributed_frac, 4)),
+            "mfu_measured": (lambda m: None if m is None else round(m, 4))(
+                self.mfu_measured(flops_per_step)),
+            "regions": {k: v.as_dict() for k, v in sorted(
+                self.regions.items(), key=lambda kv: -kv[1].us)},
+        }
+
+    def table(self, top: int = 0) -> str:
+        """The `perf report` view: regions by device time."""
+        rows = sorted(self.regions.values(), key=lambda r: -r.us)
+        if top:
+            rows = rows[:top]
+        lines = [f"device time: {self.total_device_us / 1e3:.3f} ms over "
+                 f"{self.n_steps} step(s)"
+                 + (f"  (attributed {self.attributed_frac:.0%})"
+                    if self.attributed_frac is not None else "")]
+        hdr = (f"  {'region':<28} {'time':>10} {'%':>6} {'calls':>6} "
+               f"{'category':<10} {'GFLOP':>8} {'AI':>7} {'roofline':<13} {'mfu':>6}")
+        lines.append(hdr)
+        tot = self.total_device_us or 1.0
+        for r in rows:
+            ai = "-" if r.intensity is None else f"{r.intensity:.1f}"
+            mfu = "-" if r.mfu is None else f"{r.mfu:.3f}"
+            lines.append(
+                f"  {r.name:<28} {r.us / 1e3:>8.3f}ms {100 * r.us / tot:>5.1f}% "
+                f"{r.count:>6} {r.category:<10} {r.flops / 1e9:>8.2f} {ai:>7} "
+                f"{r.roofline:<13} {mfu:>6}")
+        if self.unattributed_us:
+            lines.append(f"  {'(unattributed)':<28} {self.unattributed_us / 1e3:>8.3f}ms "
+                         f"{100 * self.unattributed_us / tot:>5.1f}%")
+        return "\n".join(lines)
+
+    def emit(self) -> None:
+        """Record the breakdown on the event bus (JSONL export) so shards
+        carry it for `tools/obs_summary.py perf`."""
+        if _obs.enabled():
+            _obs.event("device_profile", profile=self.summary_dict())
+
+
+def _event_device_side(ev: dict, proc_names: dict, thread_names: dict) -> bool:
+    """Is this trace event device work to account?
+
+    Device-process rows (TPU: ``/device:TPU:N``) all count. On host
+    processes only events carrying HLO/op metadata count — the CPU
+    backend's executor threads also emit *wrapper* events (ThunkExecutor,
+    ThreadpoolListener, Execute) that NEST over the per-op events; summing
+    them would double-count every op and leave the wrapper share forever
+    unattributable."""
+    pname = proc_names.get(ev.get("pid"), "")
+    if "/device:" in pname:
+        return True
+    args = ev.get("args") or {}
+    return ("hlo_op" in args or "hlo_module" in args
+            or "tf_op" in args or "long_name" in args)
+
+
+def _classify(name: str, args: dict) -> str:
+    hay = " ".join([name] + [str(v) for v in args.values()])
+    if _COLLECTIVE_PAT.search(hay):
+        return "collective"
+    if _TRANSFER_PAT.search(hay):
+        return "transfer"
+    return "compute"
+
+
+def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
+              n_steps: int = 1) -> DeviceProfile:
+    """Join device-side trace events to registered regions.
+
+    Join per event: every registered region name occurring in the event's
+    name / op metadata / ``hlo_module`` (minus its ``jit_`` prefix) is a
+    candidate; the finest (lowest ``level``) candidate wins, longest name
+    breaking ties — so a TPU op that carries both its scope path
+    (``...tt_fwd_bwd/xla_fusion_3/dot``) and its enclosing module
+    (``jit_tt_train_step``) lands on ``xla_fusion_3``, while a CPU event
+    with only the module name still attributes to the whole-step bucket.
+    Unmatched device events fall into the unattributed bucket."""
+    reg = region_map if region_map is not None else regions()
+    # (level, -len) order: finest granularity first, longest name first so
+    # "xla_fusion_12" wins over "xla_fusion_1"
+    names_ranked = sorted(reg, key=lambda n: (reg[n].get("level", 0), -len(n)))
+
+    proc_names: dict = {}
+    thread_names: dict = {}
+    for ev in trace_events:
+        if ev.get("ph") == "M":
+            if ev.get("name") == "process_name":
+                proc_names[ev.get("pid")] = (ev.get("args") or {}).get("name", "")
+            elif ev.get("name") == "thread_name":
+                thread_names[(ev.get("pid"), ev.get("tid"))] = (
+                    (ev.get("args") or {}).get("name", ""))
+
+    prof = DeviceProfile(n_steps=max(1, n_steps))
+    prof.peak_tflops = _flops.device_peaks()[0]
+    region_times: dict[str, RegionTime] = {}
+    t_min = None
+    t_max = None
+
+    for ev in trace_events:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur") or 0.0)
+        ts = ev.get("ts")
+        if ts is not None:
+            t_min = ts if t_min is None else min(t_min, ts)
+            t_max = (ts + dur) if t_max is None else max(t_max, ts + dur)
+        if not _event_device_side(ev, proc_names, thread_names):
+            continue
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        cat = _classify(name, args)
+        prof.total_device_us += dur
+        prof.categories[cat] = prof.categories.get(cat, 0.0) + dur
+
+        target = None
+        hay = name + " " + " ".join(str(v) for v in args.values())
+        mod = args.get("hlo_module", "")
+        if mod.startswith("jit_"):
+            hay += " " + mod[4:]
+        for rname in names_ranked:
+            if rname in hay:
+                target = rname
+                break
+        if target is None:
+            prof.unattributed_us += dur
+            continue
+        rt = region_times.get(target)
+        if rt is None:
+            info = reg.get(target, {})
+            rt = region_times[target] = RegionTime(
+                name=target,
+                bsym_ids=list(info.get("bsym_ids", [])),
+                flops=info.get("flops", 0.0),
+                bytes=info.get("bytes", 0),
+            )
+        rt.us += dur
+        rt.count += 1
+        rt.cat_us[cat] = rt.cat_us.get(cat, 0.0) + dur
+
+    for rt in region_times.values():
+        # a region's category is where its TIME went, not whatever its last
+        # event happened to be — one fused 0.1ms copy must not reclassify a
+        # 30ms compute region as comms-bound
+        if rt.cat_us:
+            rt.category = max(rt.cat_us, key=rt.cat_us.get)
+        rt.intensity = _flops.arithmetic_intensity(rt.flops, rt.bytes)
+        rt.roofline = _flops.roofline_tag(rt.flops, rt.bytes, category=rt.category)
+        if rt.category == "compute" and rt.us:
+            rt.mfu = _flops.measured_mfu(rt.flops * prof.n_steps, rt.us,
+                                         prof.peak_tflops or None)
+    prof.regions = region_times
+    if t_min is not None and t_max is not None:
+        prof.wall_us = t_max - t_min
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+
+class _Capture:
+    """Handle yielded by ``profile()``; ``.profile`` holds the parsed
+    DeviceProfile after the context exits."""
+
+    def __init__(self, log_dir: str, n_steps: int):
+        self.log_dir = log_dir
+        self.n_steps = n_steps
+        self.profile: Optional[DeviceProfile] = None
+        self.events: list[dict] = []
+
+
+@contextlib.contextmanager
+def profile(log_dir: Optional[str] = None, *, n_steps: int = 1):
+    """Capture a device profile around a block:
+
+        with observability.profile() as cap:
+            step(x); jax.block_until_ready(loss)
+        print(cap.profile.table())
+
+    The perfetto trace-event export is parsed on exit and attributed
+    through the region registry. Capture failures degrade to an empty
+    profile (``cap.profile is None``) — profiling must never take the
+    step down with it."""
+    import jax
+
+    own_dir = log_dir is None
+    if own_dir:
+        log_dir = tempfile.mkdtemp(prefix="tt_profile_")
+    cap = _Capture(log_dir, n_steps)
+    started = False
+    try:
+        with _obs.span("profile_capture", log_dir=log_dir):
+            try:
+                jax.profiler.start_trace(log_dir, create_perfetto_trace=True)
+                started = True
+            except Exception as e:  # profiler already running / unsupported
+                _obs.event("profile_error", stage="start", error=str(e)[:200])
+            try:
+                yield cap
+            finally:
+                if started:
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception as e:
+                        _obs.event("profile_error", stage="stop", error=str(e)[:200])
+                        started = False
+        if started:
+            try:
+                cap.events = _load_perfetto(log_dir)
+                cap.profile = attribute(cap.events, n_steps=cap.n_steps)
+                cap.profile.emit()
+            except Exception as e:
+                _obs.event("profile_error", stage="parse", error=str(e)[:200])
+    finally:
+        if own_dir:
+            import shutil
+
+            shutil.rmtree(log_dir, ignore_errors=True)
+
+
+def profile_steps(step_fn: Callable[[], Any], n: int = 3, *,
+                  warmup: int = 1, log_dir: Optional[str] = None) -> Optional[DeviceProfile]:
+    """Profile ``n`` calls of ``step_fn`` and return the attributed
+    DeviceProfile (None when capture failed). ``step_fn`` takes no args —
+    close over the batch; its result is block_until_ready'd so device work
+    lands inside the capture window. ``warmup`` un-profiled calls first
+    keep one-time compiles out of the measured window."""
+    import jax
+
+    for _ in range(max(0, warmup)):
+        jax.block_until_ready(step_fn())
+    with profile(log_dir, n_steps=n) as cap:
+        for _ in range(n):
+            out = step_fn()
+        jax.block_until_ready(out)
+    return cap.profile

@@ -17,18 +17,78 @@ Two concerns live here, both strictly opt-in on the hot path:
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
+import threading
 from typing import Optional
 
 from . import events
 
 _NULL = contextlib.nullcontext()
 
+# TT_OBS_SAMPLE=<rate in (0, 1]> samples step spans / per-step events so
+# always-on telemetry has bounded overhead: rate 0.1 records every 10th
+# step. Deterministic (counter modulo, not random) so tests can assert
+# exact counts; 1.0 (the default) records everything. The gate applies
+# only when the bus is enabled — disabled mode never reaches it.
+# Counters are PER SITE (per span name / per compiled function): a single
+# shared counter would alias across streams — two sites each consuming a
+# tick per step at rate 0.5 would leave one recorded 100% and the other 0%.
+_sample_every = 1
+_sample_counters: dict = {}
+_sample_lock = threading.Lock()
+
+
+def set_sample_rate(rate: float) -> None:
+    """Record roughly ``rate`` of per-step records (1.0 = all)."""
+    global _sample_every
+    if not (0.0 < rate <= 1.0):
+        raise ValueError(f"sample rate must be in (0, 1], got {rate}")
+    with _sample_lock:
+        _sample_every = max(1, round(1.0 / rate))
+        _sample_counters.clear()
+
+
+def sample_rate() -> float:
+    return 1.0 / _sample_every
+
+
+def step_sampled(site: str = "step") -> bool:
+    """One sampling decision per step for one record stream (``site``);
+    the caller applies it to every per-step record it emits (span +
+    host_overhead) so a sampled step is complete rather than a random
+    subset of its records. Each site advances its own counter, so
+    interleaved streams are each sampled at the configured rate.
+    itertools.count is a single C-level increment — thread-safe and
+    nearly free once created."""
+    if _sample_every == 1:
+        return True
+    c = _sample_counters.get(site)
+    if c is None:
+        with _sample_lock:
+            c = _sample_counters.setdefault(site, itertools.count())
+    return next(c) % _sample_every == 0
+
 
 def step_span(name: str = "step", **attrs):
-    """Latency span for one runtime step; no-op unless recording."""
+    """Latency span for one runtime step; no-op unless recording (and, under
+    TT_OBS_SAMPLE, on non-sampled steps)."""
     if not events.enabled():
         return _NULL
+    if not step_sampled(name):
+        return _NULL
     return events.span(name, **attrs)
+
+
+_env_rate = os.environ.get("TT_OBS_SAMPLE")
+if _env_rate:
+    try:
+        set_sample_rate(float(_env_rate))
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"ignoring invalid TT_OBS_SAMPLE={_env_rate!r} "
+                      f"(expected a rate in (0, 1])")
 
 
 def fusion_scope(name: str):

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from .nn.module import Module, ThunderModule, structure_epoch
 from .observability import events as _obs
+from .observability import flight_recorder as _obs_flight
 from .observability import metrics as _obs_metrics
 from .observability import runtime as _obs_runtime
 
@@ -240,9 +241,15 @@ class TrainStep:
         train_step = self
 
         def raw_step(tparam_arrays: dict, frozen_arrays: dict, opt_state, args, kwargs):
-            loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
+            # named phases: HLO traced under these scopes carries the phase
+            # name in its op metadata, so device profiles of the ONE fused
+            # step program can still attribute time to fwd+bwd vs the
+            # optimizer (the registered fusion regions nest inside tt_fwd_bwd)
+            with _obs_runtime.fusion_scope("tt_fwd_bwd"):
+                loss, grads = vag(tparam_arrays, frozen_arrays, args, kwargs)
             param_grads = grads[0][0]
-            new_params, new_state = optimizer.update(tparam_arrays, param_grads, opt_state)
+            with _obs_runtime.fusion_scope("tt_optimizer"):
+                new_params, new_state = optimizer.update(tparam_arrays, param_grads, opt_state)
             pending = vag.consume_pending_effects()
             if pending is not None:
                 # epilogue values (buffer mutations) ride out as jit outputs;
@@ -251,6 +258,19 @@ class TrainStep:
                 return loss, new_params, new_state, pending[1]
             train_step._effect_keys = None
             return loss, new_params, new_state, ()
+
+        # attribution hierarchy for device profiles: the whole-step program
+        # is named (its HLO module becomes jit_tt_train_step — the join
+        # that works on backends whose per-op events drop scope metadata),
+        # and the phase scopes above are registered one level finer so
+        # optimizer/collective time that no fusion region claims still has
+        # a bucket. Fusion regions themselves register at level 0.
+        from .observability import profiler as _obs_profiler
+
+        raw_step.__name__ = "tt_train_step"
+        _obs_profiler.register_region("tt_fwd_bwd", executor="trainstep", level=1)
+        _obs_profiler.register_region("tt_optimizer", executor="trainstep", level=1)
+        _obs_profiler.register_region("tt_train_step", executor="trainstep", level=2)
 
         donate = (0, 2) if self.donate else ()
         if plan is None:
@@ -388,9 +408,13 @@ class TrainStep:
 
     def __call__(self, *args, **kwargs):
         # one enabled() read gates ALL per-step observability: disabled mode
-        # (the default) must do zero event-bus work on the dispatch path
+        # (the default) must do zero event-bus work on the dispatch path.
+        # `sampled` additionally applies TT_OBS_SAMPLE to the per-step
+        # records (span + host_overhead) — the flight recorder stays
+        # unsampled so its p99/spike detection keeps every step.
         obs_on = _obs.enabled()
         t_host = time.perf_counter_ns() if obs_on else 0
+        sampled = obs_on and _obs_runtime.step_sampled("train_step")
         self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
             return self.micro_step(*args, **kwargs)
@@ -399,11 +423,16 @@ class TrainStep:
             self.opt_state = self.optimizer.init(tparam_arrays)
         was_built = self._jitted is not None
         if not was_built:
+            if obs_on and self._step_count > 0:
+                # a mid-run (re)build is a compile no cache served: record it
+                # so the flight recorder's spike triage can name the cause
+                _obs_metrics.record_recompile(_obs_metrics.REASON_CACHE_MISS,
+                                              fn="train_step", step=self._step_count)
             if not self._try_aot(tparam_arrays, frozen_arrays, args, kwargs):
                 self._build(args, kwargs)
                 self._maybe_save_aot(tparam_arrays, frozen_arrays, args, kwargs)
         self.last_batch = (args, kwargs)  # for memory_analysis/harnesses
-        if obs_on and was_built:
+        if sampled and was_built:
             # host dispatch overhead of a steady-state step: everything
             # between call entry and handing off to the jitted program
             # (mode check, cached split, array-dict build). Opt-in: with the
@@ -426,7 +455,7 @@ class TrainStep:
             # submission latency unless the caller reads the loss value).
             # Gated on the obs_on read from call entry: the disabled-mode
             # steady-state path must not call into the observability layer
-            with _obs_runtime.step_span("train_step") if obs_on else _NULL_SPAN:
+            with _obs.span("train_step") if sampled else _NULL_SPAN:
                 loss, new_params, self.opt_state, effects = self._jitted(
                     tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
             if effects and getattr(self, "_effect_keys", None):
@@ -436,6 +465,13 @@ class TrainStep:
         for k, p in t_pairs:
             p.data = new_params[k]
         self._step_count += 1
+        if obs_on:
+            # flight recorder: every step's wall time (submission latency +
+            # any synchronous compile) feeds the bounded ring; spikes
+            # cross-reference the bus's recent recompile/stall events
+            _obs_flight.record_step(
+                (time.perf_counter_ns() - t_host) / 1e6,
+                step=self._step_count, fn="train_step")
         return loss
 
     # -- gradient accumulation (reference ThunderModule.no_sync,

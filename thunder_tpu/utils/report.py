@@ -154,9 +154,14 @@ def save_reproducer(cfn, path: str) -> str:
     # recorded: the compile-phase spans and cache/recompile events that led
     # to this trace are exactly the context a bug report needs
     from ..observability import events as _obs
+    from ..observability import flight_recorder as _obs_flight
 
     if _obs.enabled() and _obs.records():
         _obs.dump(path + ".obs.jsonl")
+    # the step-time flight recorder rides along too: "what did the last N
+    # steps look like before this trace was saved" is post-mortem gold
+    if _obs_flight.recorder().records():
+        _obs_flight.recorder().dump(path + ".flight.json")
     return path
 
 
@@ -277,8 +282,11 @@ def timing_report(cfn, *args, iters: int = 10, warmup: int = 2,
             v = getattr(cs, attr, None)
             if v:
                 report[attr.replace("last_", "").replace("_ns", "_ms")] = v / 1e6
-        report["cache_hits"] = getattr(cs, "cache_hits", None)
-        report["cache_misses"] = getattr(cs, "cache_misses", None)
+        # int() — the counters are AtomicCounter (json-unserializable as-is)
+        hits = getattr(cs, "cache_hits", None)
+        misses = getattr(cs, "cache_misses", None)
+        report["cache_hits"] = None if hits is None else int(hits)
+        report["cache_misses"] = None if misses is None else int(misses)
         report["compile_report"] = getattr(cs, "last_compile_report", None)
 
     from ..observability import events as _obs

@@ -1,11 +1,20 @@
-"""Pretty-print a thunder_tpu observability JSONL timeline.
+"""Pretty-print thunder_tpu observability JSONL timelines.
 
-Reads the event-bus export (TT_OBS_FILE=..., observability.dump(), or the
-bench artifact OBS_TIMELINE.jsonl) and renders the three views an operator
-actually wants: the compile-phase span tree with durations, cache traffic
-and recompile reasons, and step-latency statistics.
+Reads one or more event-bus exports (TT_OBS_FILE=..., observability.dump(),
+per-process shards, or the bench artifact OBS_TIMELINE.jsonl) and renders
+the views an operator actually wants: the compile-phase span tree with
+durations, cache traffic and recompile reasons, step-latency statistics,
+and — via the ``perf`` subcommand — the device-time/FLOPs breakdown
+recorded by ``observability.profile_steps``.
 
-Usage:  python tools/obs_summary.py TIMELINE.jsonl [--top N]
+Usage:
+    python tools/obs_summary.py TIMELINE.jsonl [more.jsonl ...] [--top N]
+    python tools/obs_summary.py perf TIMELINE.jsonl [more.jsonl ...]
+
+Multiple shards are merged: records from shard i get the composite process
+key ``s<i>:<pid>`` (two hosts can share a pid) and the merged stream is
+sorted by monotonic time within each process. Exits non-zero with a clear
+message when the merged timeline holds no parseable records.
 """
 from __future__ import annotations
 
@@ -13,7 +22,8 @@ import argparse
 import json
 import sys
 
-_STEP_SPANS = ("step", "train_step", "micro_step", "infer_step")
+_STEP_SPANS = ("step", "train_step", "micro_step", "infer_step",
+               "infer_prefill", "infer_decode")
 
 
 def load(path: str) -> list[dict]:
@@ -24,10 +34,32 @@ def load(path: str) -> list[dict]:
             if not line:
                 continue
             try:
-                recs.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
-                print(f"# skipping malformed line {ln}", file=sys.stderr)
+                print(f"# {path}: skipping malformed line {ln}", file=sys.stderr)
+                continue
+            if isinstance(rec, dict):
+                recs.append(rec)
     return recs
+
+
+def load_many(paths: list[str]) -> list[dict]:
+    """Load + merge shards. With several shards, pids are namespaced per
+    shard (``s0:4242``) so span trees and counter totals from different
+    hosts never collide, then the stream is sorted by ``ts_ms`` within each
+    process (ts_ms is monotonic per process, meaningless across them)."""
+    if len(paths) == 1:
+        shards = [load(paths[0])]
+    else:
+        shards = []
+        for i, p in enumerate(paths):
+            recs = load(p)
+            for r in recs:
+                r["pid"] = f"s{i}:{r.get('pid', 0)}"
+            shards.append(recs)
+    merged = [r for recs in shards for r in recs]
+    merged.sort(key=lambda r: (str(r.get("pid", 0)), r.get("ts_ms", 0.0)))
+    return merged
 
 
 def _sid(r: dict, key: str = "span"):
@@ -143,6 +175,73 @@ def step_stats(recs: list[dict]) -> list[str]:
             f"p95={durs[min(n - 1, int(n * 0.95))]:.3f}ms  max={durs[-1]:.3f}ms"]
 
 
+def spike_lines(recs: list[dict]) -> list[str]:
+    """Flight-recorder straggler/spike events with their triaged cause."""
+    spikes = [r for r in recs if r.get("kind") == "event" and r.get("name") == "step_spike"]
+    lines = []
+    for r in spikes[-10:]:
+        a = r.get("attrs", {})
+        ratio = a.get("ratio")
+        lines.append(
+            f"  step {a.get('step', '?'):>6}  {a.get('wall_ms', '?')}ms "
+            f"({ratio}x median {a.get('median_ms', '?')}ms)  "
+            f"cause={a.get('cause', 'unknown')}"
+            + (f" reason={a['reason']}" if a.get("reason") else ""))
+    return lines
+
+
+def device_profiles(recs: list[dict]) -> list[dict]:
+    return [r["attrs"]["profile"] for r in recs
+            if r.get("kind") == "event" and r.get("name") == "device_profile"
+            and isinstance(r.get("attrs", {}).get("profile"), dict)]
+
+
+def render_perf(recs: list[dict]) -> str:
+    """The `perf report` view: per-region device time, FLOPs, arithmetic
+    intensity and roofline tags from recorded device_profile events, plus
+    step/spike statistics."""
+    profs = device_profiles(recs)
+    out = []
+    for p in profs:
+        tot = p.get("total_device_us") or 0.0
+        out.append(f"== device-time breakdown ({p.get('n_steps', '?')} step(s), "
+                   f"{tot / 1e3:.3f} ms device) ==")
+        frac = p.get("attributed_frac")
+        head = (f"  compute={p.get('compute_us', 0) / 1e3:.3f}ms  "
+                f"collective={p.get('collective_us', 0) / 1e3:.3f}ms  "
+                f"transfer={p.get('transfer_us', 0) / 1e3:.3f}ms  "
+                f"unattributed={p.get('unattributed_us', 0) / 1e3:.3f}ms")
+        if frac is not None:
+            head += f"  attributed={frac:.0%}"
+        if p.get("mfu_measured") is not None:
+            head += f"  mfu_measured={p['mfu_measured']:.3f}"
+        out.append(head)
+        out.append(f"  {'region':<28} {'time':>10} {'%':>6} {'calls':>6} "
+                   f"{'category':<10} {'GFLOP':>8} {'AI':>7} {'roofline':<13} {'mfu':>6}")
+        regions = p.get("regions") or {}
+        for name, r in sorted(regions.items(), key=lambda kv: -(kv[1].get("us") or 0)):
+            us = r.get("us") or 0.0
+            ai = r.get("intensity")
+            mfu = r.get("mfu")
+            out.append(
+                f"  {name:<28} {us / 1e3:>8.3f}ms "
+                f"{100 * us / tot if tot else 0:>5.1f}% {r.get('count', 0):>6} "
+                f"{r.get('category', ''):<10} {(r.get('flops') or 0) / 1e9:>8.2f} "
+                f"{'-' if ai is None else f'{ai:.1f}':>7} {r.get('roofline', ''):<13} "
+                f"{'-' if mfu is None else f'{mfu:.3f}':>6}")
+        out.append("")
+    steps = step_stats(recs)
+    if steps:
+        out += ["== step latency (host-side) ==", *steps]
+    spikes = spike_lines(recs)
+    if spikes:
+        out += ["", "== step spikes (flight recorder) ==", *spikes]
+    if not out:
+        return ("(no device_profile records — capture one with "
+                "observability.profile_steps(...) or BENCH_OBS=1)")
+    return "\n".join(out)
+
+
 def render(recs: list[dict], top: int = 0) -> str:
     out = []
     tree = span_tree(recs)
@@ -160,6 +259,9 @@ def render(recs: list[dict], top: int = 0) -> str:
     steps = step_stats(recs)
     if steps:
         out += ["", "== step latency (host-side) ==", *steps]
+    spikes = spike_lines(recs)
+    if spikes:
+        out += ["", "== step spikes (flight recorder) ==", *spikes]
     host = host_overhead_stats(recs)
     if host:
         out += ["", "== host dispatch overhead ==", *host]
@@ -173,11 +275,26 @@ def render(recs: list[dict], top: int = 0) -> str:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    perf = bool(argv) and argv[0] == "perf"
+    if perf:
+        argv = argv[1:]
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("timeline", help="JSONL file written by TT_OBS_FILE / observability.dump()")
+    ap.add_argument("timeline", nargs="+",
+                    help="JSONL shard(s) written by TT_OBS_FILE / observability.dump(); "
+                         "several shards are merged by process")
     ap.add_argument("--top", type=int, default=0, help="show at most N span-tree lines")
     ns = ap.parse_args(argv)
-    print(render(load(ns.timeline), top=ns.top))
+    try:
+        recs = load_many(ns.timeline)
+    except OSError as e:
+        print(f"error: cannot read timeline: {e}", file=sys.stderr)
+        return 2
+    if not recs:
+        print(f"error: no parseable records in {', '.join(ns.timeline)} "
+              f"(empty or entirely malformed timeline)", file=sys.stderr)
+        return 2
+    print(render_perf(recs) if perf else render(recs, top=ns.top))
     return 0
 
 
