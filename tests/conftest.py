@@ -33,6 +33,15 @@ def rng():
     return np.random.RandomState(1234)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (deselected by the tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "fault: fault-injection test (exercises TT_FAULT recovery paths; "
+        "filter with -m fault / -m 'not fault')")
+
+
 def pytest_collection_modifyitems(config, items):
     # TT_TEST_ORDER_SEED=<int> runs the suite in a seeded random order to
     # flush out cross-test global-state leaks (registry/cache pollution).

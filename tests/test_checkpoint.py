@@ -173,6 +173,62 @@ def test_load_model_state_dict_shape_mismatch_raises():
         dist_ckpt.load_model_state_dict(bad, tm)
 
 
+def _plain_module_state():
+    """Single-device state dict with dtype diversity (f32/bf16/i32) — the
+    fallback-matrix tests must not depend on shard_map availability."""
+    m = Net()
+    tm = tt.jit(m)
+    sd = {k: p.data for k, p in tm.get_parameters().items()}
+    sd["extra.bf16"] = jnp.asarray(np.arange(12).reshape(3, 4), jnp.bfloat16)
+    sd["extra.i32"] = jnp.asarray([1, 2, 3], jnp.int32)
+    return tm, sd
+
+
+@pytest.mark.parametrize("full", [False, True])
+@pytest.mark.parametrize("cpu", [False, True])
+@pytest.mark.parametrize("rank0", [False, True])
+def test_numpy_fallback_roundtrip_all_option_combos(full, cpu, rank0, monkeypatch):
+    """Pin the orbax-less CI path: every StateDictOptions combination must
+    round-trip through the numpy fallback with dtype/shape/value fidelity
+    (the fallback is what actually runs when orbax is absent, so it cannot
+    be 'covered' transitively by the orbax tests)."""
+    monkeypatch.setattr(dist_ckpt, "_orbax", lambda: None)
+    tm, sd = _plain_module_state()
+    opts = dist_ckpt.StateDictOptions(full_state_dict=full, cpu_offload=cpu,
+                                      rank0_only=rank0)
+    model_sd = dist_ckpt.get_model_state_dict(tm, opts)
+    assert model_sd, "single-host process 0 must always materialize a state dict"
+    if full or cpu:
+        assert all(isinstance(v, np.ndarray) for v in model_sd.values())
+    want = {k: np.asarray(v).copy() for k, v in sd.items()}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "ckpt")
+        dist_ckpt.save(sd, path, options=opts)
+        assert os.path.exists(os.path.join(path, "state.npz"))  # fallback format
+        back = dist_ckpt.load(path, like=sd)
+    for k in want:
+        got = np.asarray(back[k])
+        assert got.dtype == want[k].dtype, f"{k}: dtype {got.dtype} != {want[k].dtype}"
+        assert got.shape == want[k].shape, f"{k}: shape {got.shape} != {want[k].shape}"
+        np.testing.assert_array_equal(got, want[k], err_msg=k)
+
+
+def test_numpy_fallback_save_is_atomic(monkeypatch):
+    """A crash mid-write must not leave a partial state.npz behind (tmp +
+    os.replace, the aot_cache idiom)."""
+    monkeypatch.setattr(dist_ckpt, "_orbax", lambda: None)
+    sd = {"w": np.arange(6, dtype=np.float32)}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "ckpt")
+        real_replace = os.replace
+        monkeypatch.setattr(os, "replace", lambda *a: (_ for _ in ()).throw(OSError("disk full")))
+        with pytest.raises(OSError, match="disk full"):
+            dist_ckpt.save(sd, path)
+        monkeypatch.setattr(os, "replace", real_replace)
+        assert not os.path.exists(os.path.join(path, "state.npz"))
+        assert not [f for f in os.listdir(path) if f.endswith(".tmp")]
+
+
 def test_rank0_only_sharded_raises_or_gathers():
     """save(rank0_only=True) without full/cpu materialization must not leave
     rank 0 holding sharded arrays silently — single-host it gathers; the

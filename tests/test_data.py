@@ -105,6 +105,66 @@ def test_fallback_stream_deterministic_given_seed(token_file):
     b.close()
 
 
+@pytest.mark.parametrize("native", [True, False])
+def test_short_corpus_raises_up_front(tmp_path, native):
+    """A corpus shorter than seq_len+1 must fail in TokenLoader.__init__ on
+    the caller's thread with a clear ValueError — not inside the native/
+    fallback worker where the error would be silently lost."""
+    path = str(tmp_path / "short.bin")
+    write_token_file(path, np.arange(10), token_bytes=2)
+    with pytest.raises(ValueError, match="need at least seq_len\\+1=17"):
+        TokenLoader(path, batch_size=2, seq_len=16, native=native)
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_state_dict_resume_continues_stream_exactly(token_file, native):
+    """Checkpoint cursor: a fresh loader restored from state_dict() serves
+    exactly the batches the original loader would have served next (the
+    resume contract CheckpointManager relies on for bit-identical runs)."""
+    path, _ = token_file
+    a = TokenLoader(path, batch_size=4, seq_len=32, seed=11, native=native)
+    served = [a.next_batch() for _ in range(5)]
+    sd = a.state_dict()
+    assert sd["served"] == 5 and sd["seed"] == 11
+    expected = [a.next_batch() for _ in range(4)]
+    a.close()
+    b = TokenLoader(path, batch_size=4, seq_len=32, seed=999, native=native)
+    b.next_batch()  # a drifted loader: resume must fully re-position it
+    b.load_state_dict(sd)
+    for want_x, want_y in expected:
+        got_x, got_y = b.next_batch()
+        np.testing.assert_array_equal(want_x, got_x)
+        np.testing.assert_array_equal(want_y, got_y)
+    assert b.state_dict()["served"] == 9
+    b.close()
+
+
+def test_load_state_dict_shape_mismatch_raises(token_file):
+    path, _ = token_file
+    a = TokenLoader(path, batch_size=4, seq_len=32, native=False)
+    sd = a.state_dict()
+    a.close()
+    b = TokenLoader(path, batch_size=2, seq_len=32, native=False)
+    with pytest.raises(ValueError, match="state mismatch"):
+        b.load_state_dict(sd)
+    b.close()
+
+
+def test_load_state_dict_cross_path_raises(token_file):
+    """A cursor saved on one serving path must refuse to resume on the other:
+    the native and fallback rng streams differ, so a cross-path resume would
+    silently serve a diverging batch stream."""
+    path, _ = token_file
+    a = TokenLoader(path, batch_size=4, seq_len=32, seed=1)  # native
+    assert a.is_native
+    sd = a.state_dict()
+    a.close()
+    b = TokenLoader(path, batch_size=4, seq_len=32, seed=1, native=False)
+    with pytest.raises(ValueError, match="serving"):
+        b.load_state_dict(sd)
+    b.close()
+
+
 def test_batches_vary(token_file):
     path, _ = token_file
     loader = TokenLoader(path, batch_size=2, seq_len=32, seed=3)

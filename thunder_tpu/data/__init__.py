@@ -106,6 +106,24 @@ class TokenLoader:
         self.seq_len = seq_len
         self.token_bytes = token_bytes
         self.span = seq_len + 1
+        self._seed = seed
+        self._n_threads = n_threads
+        self._queue_depth = queue_depth
+        self._served = 0  # batches handed to the consumer (checkpoint cursor)
+        # validate the corpus up front, on the caller's thread, for BOTH
+        # serving paths: a corpus shorter than span would otherwise blow up
+        # inside the native/fallback worker where the error is silently lost
+        # (the fallback worker's rng.randint(0, n - span + 1) raises with n
+        # tokens < span) or surface as an opaque delayed RuntimeError
+        try:
+            n_file_tokens = os.path.getsize(path) // token_bytes
+        except OSError as e:
+            raise ValueError(f"cannot read token file {path!r}: {e}") from None
+        if n_file_tokens < self.span:
+            raise ValueError(
+                f"token file {path!r} has {n_file_tokens} tokens, "
+                f"need at least seq_len+1={self.span}"
+            )
         self._handle = None
         self._lib = _native_lib() if native else None
         if self._lib is not None:
@@ -120,24 +138,22 @@ class TokenLoader:
         if self._lib is None:
             dtype = {1: np.uint8, 2: np.uint16, 4: np.int32}[token_bytes]
             self._tokens = np.memmap(path, dtype=dtype, mode="r")
-            if self._tokens.shape[0] < self.span:
-                raise ValueError(
-                    f"token file {path!r} has {self._tokens.shape[0]} tokens, "
-                    f"need at least seq_len+1={self.span}"
-                )
             self._rng = np.random.RandomState(seed)
-            self._fb_queue = queue.Queue(maxsize=max(1, queue_depth))
-            self._fb_stop = threading.Event()
-            self._fb_thread = threading.Thread(
-                target=_fallback_worker,
-                args=(self._tokens, self._rng, batch_size, self.span,
-                      self._fb_queue, self._fb_stop),
-                name="tt-token-fallback", daemon=True)
-            self._fb_thread.start()
+            self._start_fallback_worker()
         else:
             # native output buffer; the fallback path receives
             # worker-allocated buffers through _fb_queue instead
             self._buf = np.empty((batch_size, self.span), np.int32)
+
+    def _start_fallback_worker(self) -> None:
+        self._fb_queue = queue.Queue(maxsize=max(1, self._queue_depth))
+        self._fb_stop = threading.Event()
+        self._fb_thread = threading.Thread(
+            target=_fallback_worker,
+            args=(self._tokens, self._rng, self.batch_size, self.span,
+                  self._fb_queue, self._fb_stop),
+            name="tt-token-fallback", daemon=True)
+        self._fb_thread.start()
 
     @property
     def is_native(self) -> bool:
@@ -169,7 +185,73 @@ class TokenLoader:
                         raise RuntimeError("fallback loader worker exited") from None
             if isinstance(batch, Exception):
                 raise batch
+        self._served += 1
         return batch[:, :-1].copy(), batch[:, 1:].copy()
+
+    # -- checkpointable cursor (robustness.CheckpointManager) ---------------
+
+    def state_dict(self) -> dict:
+        """JSON-safe batch-stream cursor. Both serving paths are
+        deterministic functions of (seed, batch index), so (seed, batches
+        served) pins the exact continuation point of the stream."""
+        return {"seed": int(self._seed), "served": int(self._served),
+                "batch_size": int(self.batch_size), "span": int(self.span),
+                "token_bytes": int(self.token_bytes),
+                "native": bool(self.is_native)}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Re-position the stream so the next ``next_batch()`` returns
+        exactly the batch a checkpointed run would have drawn next.
+
+        Fallback path: a fresh RandomState(seed) replays ``served`` offset
+        draws (cheap — one randint call per skipped batch). Native path: the
+        stream is recreated at ``seed`` and ``served`` batches are assembled
+        and discarded (batches are keyed by (seed, index)); resuming very
+        deep into a native stream pays that assembly cost once."""
+        if (int(sd["batch_size"]) != self.batch_size
+                or int(sd["span"]) != self.span
+                or int(sd.get("token_bytes", self.token_bytes)) != self.token_bytes):
+            raise ValueError(
+                f"loader state mismatch: checkpoint batch_size/span/token_bytes "
+                f"{sd['batch_size']}/{sd['span']}/{sd.get('token_bytes')} vs "
+                f"loader {self.batch_size}/{self.span}/{self.token_bytes} — "
+                f"resuming onto a differently-tokenized corpus would silently "
+                f"serve an unrelated batch stream")
+        if "native" in sd and bool(sd["native"]) != self.is_native:
+            # the two serving paths draw from DIFFERENT rng streams (native:
+            # per-batch mt19937_64 keyed by (seed, index); fallback: one
+            # sequential numpy RandomState) — a cursor from one cannot
+            # reproduce the other's continuation
+            raise ValueError(
+                f"loader state mismatch: checkpoint cursor is from the "
+                f"{'native' if sd['native'] else 'numpy-fallback'} serving "
+                f"path but this loader is "
+                f"{'native' if self.is_native else 'numpy-fallback'}; the "
+                f"paths' batch streams differ, so resuming across them "
+                f"would silently diverge from the checkpointed run")
+        seed, served = int(sd["seed"]), int(sd["served"])
+        if self._handle is not None:
+            self._lib.ttl_destroy(self._handle)
+            self._handle = self._lib.ttl_create(
+                self.path.encode(), self.token_bytes, self.batch_size,
+                self.span, seed, self._n_threads, self._queue_depth)
+            if not self._handle:
+                raise RuntimeError("native loader failed to reopen for resume")
+            scratch = np.empty((self.batch_size, self.span), np.int32)
+            for _ in range(served):
+                rc = self._lib.ttl_next(
+                    self._handle, scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                if rc != 0:
+                    raise RuntimeError("native loader failed during resume replay")
+        else:
+            _drain_and_join(self._fb_queue, self._fb_stop, self._fb_thread)
+            self._rng = np.random.RandomState(seed)
+            n = self._tokens.shape[0]
+            for _ in range(served):
+                self._rng.randint(0, n - self.span + 1, self.batch_size)
+            self._start_fallback_worker()
+        self._seed = seed
+        self._served = served
 
     def batches(self):
         """Endless (inputs, targets) iterator — feed to prefetch_to_device."""

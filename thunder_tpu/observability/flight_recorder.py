@@ -89,21 +89,36 @@ class FlightRecorder:
     def _likely_cause(self) -> tuple[str, dict]:
         """Scan the bus's most recent records for the event that explains a
         slow step. Priority: a recompile (reason-coded, the usual killer) →
-        a data stall (prefetch underrun) → an outsized host_overhead →
-        unknown."""
+        a guard intervention (retry/rollback stretch the step wall time) →
+        an overlapping checkpoint save (host snapshot + writer IO contend
+        with dispatch) → a data stall (prefetch underrun) → an outsized
+        host_overhead → unknown. Within one category the most recent event
+        wins; across categories the priority order wins even when a
+        routine lower-priority event is more recent."""
         # the public accessor copies under the bus lock; iterating the live
         # deque would race concurrent emitters (safe only by GIL accident)
         recent = _obs.records()[-_CAUSE_WINDOW_RECORDS:]
         host_us = [r["attrs"].get("us", 0.0) for r in recent
                    if r.get("kind") == "event" and r.get("name") == "host_overhead"]
+        found: dict[str, tuple[str, dict]] = {}
         for r in reversed(recent):
             if r.get("kind") != "event":
                 continue
             name = r.get("name")
-            if name == "recompile":
-                return "recompile", {"reason": (r.get("attrs") or {}).get("reason")}
-            if name in ("data_stall", "prefetch_stall"):
-                return "data-stall", {"stall_ms": (r.get("attrs") or {}).get("ms")}
+            attrs = r.get("attrs") or {}
+            if name == "recompile" and "recompile" not in found:
+                found["recompile"] = ("recompile", {"reason": attrs.get("reason")})
+            elif name == "guard" and "guard" not in found:
+                found["guard"] = ("guard-intervention", {"reason": attrs.get("reason")})
+            elif name == "checkpoint_save" and "ckpt" not in found:
+                found["ckpt"] = ("checkpoint-save",
+                                 {"ckpt_step": attrs.get("step"),
+                                  "save_ms": attrs.get("ms")})
+            elif name in ("data_stall", "prefetch_stall") and "stall" not in found:
+                found["stall"] = ("data-stall", {"stall_ms": attrs.get("ms")})
+        for key in ("recompile", "guard", "ckpt", "stall"):
+            if key in found:
+                return found[key]
         if len(host_us) >= 2 and host_us[-1] > 5.0 * (sorted(host_us)[len(host_us) // 2] or 1.0):
             return "host-overhead", {"host_us": host_us[-1]}
         return "unknown", {}

@@ -110,6 +110,19 @@ REASON_STALE_KEY = "stale-key"
 
 REASON_CODES = (REASON_CACHE_MISS, REASON_SHAPE_CHANGE, REASON_FALLBACK, REASON_STALE_KEY)
 
+# Robustness-layer intervention reason codes (the guard/checkpoint analog of
+# the recompile vocabulary above; consumed by the flight recorder's spike
+# triage and tools/obs_summary.py):
+#
+#   nonfinite-skip       a NaN/Inf step's update was gated off in-program
+#   nonfinite-raise      the guard policy (or an exhausted skip budget) raised
+#   rollback             N consecutive bad steps restored the last checkpoint
+#   transient-retry      a transient runtime error was retried with backoff
+#   transient-exhausted  the retry budget ran out; the error propagated
+#   preempt              SIGTERM drained into a final checkpoint
+INTERVENTION_CODES = ("nonfinite-skip", "nonfinite-raise", "rollback",
+                      "transient-retry", "transient-exhausted", "preempt")
+
 
 def record_cache(cache: str, outcome: str, **attrs) -> None:
     """One cache lookup outcome: outcome in {"hit", "miss", "evict"}."""
@@ -124,6 +137,16 @@ def record_recompile(reason: str, **attrs) -> None:
         return
     events.inc(f"recompile.{reason}")
     events.event("recompile", reason=reason, **attrs)
+
+
+def record_intervention(reason: str, **attrs) -> None:
+    """A robustness-layer intervention (guard skip/raise/rollback, transient
+    retry, preemption drain), reason-coded like recompiles so spike triage
+    and the CLI can name it."""
+    if not events.enabled():
+        return
+    events.inc(f"guard.{reason}")
+    events.event("guard", reason=reason, **attrs)
 
 
 def record_fusion(executor: str, n_regions: int, n_ops: int, **attrs) -> None:

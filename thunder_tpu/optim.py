@@ -14,6 +14,17 @@ import jax
 import jax.numpy as jnp
 
 
+def global_norm(grads) -> jnp.ndarray:
+    """L2 norm over every leaf of a gradient pytree, accumulated in f32
+    (the step-guard NaN/Inf gate and grad-clip recipes share this so the
+    in-program health metric matches what clipping would see)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
 class SGD:
     def __init__(self, lr: float = 0.01, momentum: float = 0.0, weight_decay: float = 0.0):
         self.lr = lr

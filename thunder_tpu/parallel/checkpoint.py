@@ -7,6 +7,7 @@ with a plain-numpy fallback; `StateDictOptions`-style full-vs-sharded modes
 are preserved."""
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass
 from typing import Optional
@@ -27,6 +28,17 @@ class StateDictOptions:
     full_state_dict: bool = False
     cpu_offload: bool = False
     rank0_only: bool = False
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extension types
+    (bfloat16, float8_*) that plain np.dtype() does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def _orbax():
@@ -73,9 +85,32 @@ def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None
         return
     os.makedirs(path, exist_ok=True)
     flat, treedef = jax.tree_util.tree_flatten(state_dict)
-    np.savez(os.path.join(path, "state.npz"), *[np.asarray(x) for x in flat])
-    with open(os.path.join(path, "treedef.txt"), "w") as f:
-        f.write(str(treedef))
+    arrays = [np.asarray(x) for x in flat]
+    # np.savez silently degrades extension dtypes (bfloat16, fp8 variants)
+    # to raw void bytes; record the true dtype names so load can view()
+    # them back — a checkpoint that changes dtypes is not a checkpoint. The
+    # manifest rides INSIDE the npz so the write stays single-file atomic
+    # (a sidecar file could pair with the wrong npz across a crashed
+    # overwrite)
+    dtype_names = np.array(json.dumps([str(a.dtype) for a in arrays]))
+    # tmp + os.replace (the aot_cache idiom): a crash mid-write must never
+    # leave a partial state.npz that a later load would trust. The treedef
+    # (debugging aid: load() reconstructs structure from `like`) rides
+    # inside the npz too — a sidecar written after the replace could pair
+    # with the wrong payload across a crashed overwrite
+    final = os.path.join(path, "state.npz")
+    tmp = f"{final}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, *arrays, __tt_dtypes__=dtype_names,
+                     __tt_treedef__=np.array(str(treedef)))
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path: str, *, like: dict | None = None, options: StateDictOptions | None = None) -> dict:
@@ -95,7 +130,16 @@ def load(path: str, *, like: dict | None = None, options: StateDictOptions | Non
             return ckptr.restore(path, restore_args=restore_args)
         return ckptr.restore(path)
     data = np.load(os.path.join(path, "state.npz"))
-    arrays = [data[k] for k in data.files]
+    arrays = [data[k] for k in data.files
+              if k not in ("__tt_dtypes__", "__tt_treedef__")]
+    if "__tt_dtypes__" in data.files:  # absent in pre-dtype-manifest checkpoints
+        names = json.loads(str(data["__tt_dtypes__"]))
+        if len(names) != len(arrays):
+            raise ValueError(
+                f"corrupt checkpoint {path!r}: dtype manifest lists "
+                f"{len(names)} arrays, payload has {len(arrays)}")
+        arrays = [a if str(a.dtype) == name else a.view(_np_dtype(name))
+                  for a, name in zip(arrays, names)]
     if like is None:
         raise ValueError("numpy-fallback load requires `like` for the tree structure")
     flat, treedef = jax.tree_util.tree_flatten(like)

@@ -1,0 +1,35 @@
+"""thunder_tpu.robustness: fault-tolerant training.
+
+The production-scale counterpart of "hope the job survives": preemption-safe
+checkpoint/resume (``CheckpointManager``), SIGTERM draining
+(``PreemptionHandler`` / ``Preempted``), NaN/rollback/retry step guards
+(``StepGuard`` / ``GuardPolicy``), and a deterministic fault-injection
+harness (``faults``, TT_FAULT env knob) that keeps every recovery path
+covered by tests. See docs/robustness.md for the walkthrough.
+
+Quick start::
+
+    from thunder_tpu.robustness import CheckpointManager, GuardPolicy, StepGuard
+
+    guard = StepGuard(GuardPolicy(on_nonfinite="skip", retry_transient=2))
+    step = TrainStep(tm, optim.AdamW(1e-3), guard=guard)
+    mgr = CheckpointManager("ckpts/", every_n_steps=500, loader=loader).attach(step)
+    try:
+        for x, y in loader.batches():
+            step(x, y)
+    except robustness.Preempted:
+        pass                      # final checkpoint is durable; exit cleanly
+    # fresh process: CheckpointManager("ckpts/", loader=loader).restore(step)
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from .checkpoint_manager import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    list_steps,
+    read_meta,
+    validate_step,
+)
+from .guards import GuardPolicy, NonFiniteLossError, StepGuard  # noqa: F401
+from .preemption import Preempted, PreemptionHandler  # noqa: F401

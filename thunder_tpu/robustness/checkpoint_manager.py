@@ -1,0 +1,434 @@
+"""CheckpointManager: periodic + on-signal full-training-state checkpoints.
+
+``parallel/checkpoint.py`` gives sharded save/load primitives; this layer
+makes them a *recovery policy* for the training loop:
+
+* the FULL resumable state is captured — params (at their padded storage
+  shapes, bit-exact), buffers (which carry fp8 amax-history scaling state),
+  optimizer state, the step counter, and the ``TokenLoader`` cursor/RNG
+  replay state — not just a weights file;
+* every checkpoint is written **atomically**: payload + ``meta.json`` +
+  digest ``manifest.json`` land in a hidden tmp directory that is
+  ``os.replace``d into place (the aot_cache tmp+rename idiom, directory
+  scale), so a kill mid-write can never leave a latest-looking half
+  checkpoint;
+* saves are **async by default**: the step loop pays one host snapshot
+  (``np.asarray`` of the state tree) and a writer thread does the IO;
+* save failures are **non-fatal by default** (warn + ``checkpoint.save_failed``
+  bus event + keep training) with ``strict=True`` raising instead;
+* retention is keep-last-K;
+* a ``PreemptionHandler`` flag checked in ``on_step`` turns SIGTERM into:
+  drain the in-flight step, force a final blocking save, raise ``Preempted``.
+
+Hot-path discipline: ``on_step`` at a non-interval step is two attribute
+reads, an ``Event.is_set`` and an int modulo — the same zero-work contract
+as the disabled observability bus (counter-asserted in
+tests/test_robustness.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..observability import events as _obs
+from ..observability import metrics as _obs_metrics
+from ..parallel import checkpoint as dist_ckpt
+from . import faults as _faults
+from .preemption import Preempted, PreemptionHandler
+
+STEP_PREFIX = "step_"
+_STATE_SUBDIR = "state"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint save failed in strict mode (or a restore found nothing)."""
+
+
+# -- directory helpers (shared with tools/ckpt_inspect.py) -------------------
+
+def step_dir_name(step: int) -> str:
+    return f"{STEP_PREFIX}{step:08d}"
+
+
+def list_steps(directory: str) -> list[tuple[int, str]]:
+    """[(step, abspath)] of checkpoint step dirs, ascending by step."""
+    out = []
+    if not os.path.isdir(directory):
+        return out
+    for name in os.listdir(directory):
+        if not name.startswith(STEP_PREFIX):
+            continue
+        try:
+            step = int(name[len(STEP_PREFIX):])
+        except ValueError:
+            continue
+        out.append((step, os.path.join(os.path.abspath(directory), name)))
+    out.sort()
+    return out
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_files(stepdir: str) -> dict[str, dict]:
+    files = {}
+    for dirpath, dirnames, filenames in sorted(os.walk(stepdir)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if fn == "manifest.json":
+                continue
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, stepdir)
+            files[rel] = {"sha256": _file_digest(p), "bytes": os.path.getsize(p)}
+    return files
+
+
+def validate_step(stepdir: str) -> tuple[bool, list[str]]:
+    """Check a step dir's manifest integrity: every listed file present with
+    a matching digest, no payload file missing from the manifest."""
+    problems: list[str] = []
+    mpath = os.path.join(stepdir, "manifest.json")
+    if not os.path.isfile(mpath):
+        return False, ["manifest.json missing"]
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, [f"manifest.json unreadable: {e}"]
+    listed = manifest.get("files", {})
+    actual = _manifest_files(stepdir)
+    for rel, info in listed.items():
+        if rel not in actual:
+            problems.append(f"missing file: {rel}")
+        elif actual[rel]["sha256"] != info.get("sha256"):
+            problems.append(f"digest mismatch: {rel}")
+    for rel in actual:
+        if rel not in listed:
+            problems.append(f"unlisted file: {rel}")
+    return not problems, problems
+
+
+def read_meta(stepdir: str) -> dict:
+    with open(os.path.join(stepdir, "meta.json")) as f:
+        return json.load(f)
+
+
+# -- the manager -------------------------------------------------------------
+
+class CheckpointManager:
+    """Attach to a ``TrainStep`` (and optionally a ``TokenLoader``); periodic
+    and preemption-forced saves then ride the step loop.
+
+        mgr = CheckpointManager(dir, every_n_steps=500, keep=3, loader=loader)
+        mgr.attach(step)                 # installs the SIGTERM handler too
+        for x, y in loader.batches():    # mgr.on_step runs inside step(...)
+            step(x, y)
+
+    Resume in a fresh process::
+
+        mgr = CheckpointManager(dir, loader=loader)
+        meta = mgr.restore(step)         # params/opt/step-counter/loader back
+    """
+
+    def __init__(self, directory: str, *, every_n_steps: int = 0, keep: int = 3,
+                 async_save: bool = True, strict: bool = False,
+                 loader=None, preemption: bool = True):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = os.path.abspath(directory)
+        self.every_n_steps = int(every_n_steps)
+        self.keep = keep
+        self.async_save = async_save
+        self.strict = strict
+        self.loader = loader
+        self._preempt: Optional[PreemptionHandler] = (
+            PreemptionHandler() if preemption else None)
+        self._writer: Optional[threading.Thread] = None
+        self._last_error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        # observable outcomes (tests / ckpt_inspect)
+        self.saves = 0
+        self.failed_saves = 0
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, train_step) -> "CheckpointManager":
+        train_step._ckpt_manager = self
+        if self._preempt is not None:
+            self._preempt.install()
+        return self
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt is not None and self._preempt.preempted.is_set()
+
+    def on_step(self, train_step) -> None:
+        """Per-step hook (called by TrainStep.__call__ after the step counter
+        advances). MUST stay zero-work when idle: the non-interval path below
+        is an Event read and an int modulo."""
+        if self._preempt is not None and self._preempt.preempted.is_set():
+            self._finalize_preempt(train_step)
+        every = self.every_n_steps
+        if every and train_step._step_count % every == 0:
+            self.save(train_step)
+
+    # -- state capture ------------------------------------------------------
+
+    def _collect(self, train_step) -> tuple[dict, dict]:
+        """(state tree of live arrays, JSON-safe meta)."""
+        tmodule = train_step.tmodule
+        params = {k: getattr(p, "data", p) for k, p in tmodule.get_parameters().items()}
+        buffers = {}
+        getb = getattr(tmodule, "get_buffers", None)
+        if callable(getb):
+            buffers = dict(getb())
+        state = {"params": params, "buffers": buffers,
+                 "opt_state": train_step.opt_state if train_step.opt_state is not None else {}}
+        meta = {
+            "step": train_step._step_count,
+            "saved_at": time.time(),
+            "has_opt_state": train_step.opt_state is not None,
+            "n_params": len(params),
+            "n_buffers": len(buffers),
+            "opt_state_leaves": len(jax.tree_util.tree_leaves(state["opt_state"])),
+            "loader": None,
+        }
+        loader_sd = getattr(self.loader, "state_dict", None)
+        if callable(loader_sd):
+            meta["loader"] = loader_sd()
+        return state, meta
+
+    @staticmethod
+    def _snapshot(state: dict) -> dict:
+        """Host snapshot: the step loop may donate/overwrite device buffers on
+        the very next step, so the writer must own plain numpy copies."""
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), state)
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, train_step, *, block: Optional[bool] = None,
+             reason: str = "interval") -> Optional[str]:
+        """Checkpoint the full training state. Returns the final step-dir path
+        for blocking saves, None for async ones (poll ``wait()``)."""
+        self.wait()  # one in-flight write at a time; surfaces strict errors
+        step = train_step._step_count
+        state, meta = self._collect(train_step)
+        snap = self._snapshot(state)
+        final = os.path.join(self.directory, step_dir_name(step))
+        _obs.event("checkpoint_save", phase="start", step=step, reason=reason)
+        blocking = (not self.async_save) if block is None else block
+        if blocking:
+            self._write(snap, meta, final)
+            if self.strict:
+                self.wait()  # re-raises the stored write error, if any
+            return final if self._last_error is None else None
+        t = threading.Thread(target=self._write, args=(snap, meta, final),
+                             name="tt-ckpt-writer", daemon=True)
+        with self._lock:
+            self._writer = t
+        t.start()
+        return None
+
+    def _write(self, snap: dict, meta: dict, final: str) -> None:
+        t0 = time.perf_counter()
+        step = meta["step"]
+        tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
+        try:
+            if _faults.active():
+                _faults.maybe_raise("ckpt_fail", step)
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+            dist_ckpt.save(snap, os.path.join(tmp, _STATE_SUBDIR))
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True)
+            manifest = {"step": step, "format": "checkpoint-v1",
+                        "files": _manifest_files(tmp)}
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            # overwrite via rename-aside: rmtree(final) before the replace
+            # would open a crash window that destroys a DURABLE checkpoint
+            # with its replacement not yet in place (e.g. the re-save that
+            # follows a rollback restore). The aside dir fails list_steps's
+            # int() parse, so a crash between the two renames leaves the old
+            # data on disk without ever being mistaken for a live step.
+            aside = None
+            if os.path.isdir(final):
+                aside = f"{final}.old-{os.getpid()}"
+                shutil.rmtree(aside, ignore_errors=True)
+                os.replace(final, aside)
+            os.replace(tmp, final)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+        except BaseException as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            self.failed_saves += 1
+            _obs.event("checkpoint.save_failed", step=step,
+                       error=f"{type(e).__name__}: {e}"[:300])
+            _obs.inc("checkpoint.save_failed")
+            with self._lock:
+                self._last_error = e
+            if not self.strict:
+                warnings.warn(
+                    f"checkpoint save at step {step} failed (non-fatal): "
+                    f"{type(e).__name__}: {e}", stacklevel=2)
+            return
+        self.saves += 1
+        with self._lock:
+            self._last_error = None
+        _obs.event("checkpoint_save", phase="done", step=step,
+                   ms=round((time.perf_counter() - t0) * 1e3, 3))
+        _obs.inc("checkpoint.saved")
+        self._prune()
+
+    def wait(self) -> None:
+        """Join any in-flight async write; in strict mode re-raise its error
+        on the caller's (step-loop) thread."""
+        with self._lock:
+            t = self._writer
+        if t is not None:
+            t.join()
+            with self._lock:
+                self._writer = None
+        if self.strict:
+            with self._lock:
+                err, self._last_error = self._last_error, None
+            if err is not None:
+                raise CheckpointError("checkpoint save failed") from err
+
+    def close(self) -> None:
+        self.wait()
+        if self._preempt is not None:
+            self._preempt.uninstall()
+
+    def _prune(self) -> None:
+        steps = list_steps(self.directory)
+        for _, path in steps[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+            _obs.inc("checkpoint.pruned")
+        # sweep rename-aside/tmp leftovers from crashed EARLIER processes
+        # (never this pid's: _write cleans its own, and racing a live writer
+        # from a future multi-writer setup would corrupt an in-flight save)
+        own = f"-{os.getpid()}"
+        for name in os.listdir(self.directory):
+            if (".old-" in name or name.startswith(".tmp-")) and not name.endswith(own):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # -- preemption ---------------------------------------------------------
+
+    def _finalize_preempt(self, train_step) -> None:
+        step = train_step._step_count
+        path = None
+        try:
+            path = self.save(train_step, block=True, reason="preempt")
+        except BaseException as e:
+            warnings.warn(f"final preemption checkpoint failed: {e}", stacklevel=2)
+        _obs.event("preempt_checkpoint", step=step, path=path)
+        _obs_metrics.record_intervention("preempt", step=step,
+                                         saved=path is not None)
+        raise Preempted(
+            f"preempted at step {step}"
+            + (f"; checkpoint saved to {path}" if path else "; final checkpoint FAILED"),
+            step=step, checkpoint_path=path)
+
+    # -- restore ------------------------------------------------------------
+
+    def latest(self) -> Optional[tuple[int, str]]:
+        """Newest step dir that passes manifest validation (corrupt/partial
+        checkpoints are skipped with a warning, falling back to older ones)."""
+        for step, path in reversed(list_steps(self.directory)):
+            ok, problems = validate_step(path)
+            if ok:
+                return step, path
+            warnings.warn(f"skipping invalid checkpoint {path}: {problems}",
+                          stacklevel=2)
+        return None
+
+    def restore(self, train_step, *, step: Optional[int] = None,
+                loader=None) -> dict:
+        """Restore the full training state into ``train_step`` (and the
+        loader). Returns the checkpoint's meta dict. Round-trips to
+        bit-identical forward results: params are saved/restored at their
+        exact storage shapes and dtypes."""
+        self.wait()
+        if step is None:
+            found = self.latest()
+            if found is None:
+                raise CheckpointError(
+                    f"no valid checkpoint found in {self.directory}")
+            step, stepdir = found
+        else:
+            stepdir = os.path.join(self.directory, step_dir_name(step))
+            ok, problems = validate_step(stepdir)
+            if not ok:
+                raise CheckpointError(
+                    f"checkpoint {stepdir} failed validation: {problems}")
+        meta = read_meta(stepdir)
+        tmodule = train_step.tmodule
+        live_params = tmodule.get_parameters()
+        params = {k: getattr(p, "data", p) for k, p in live_params.items()}
+        buffers = {}
+        getb = getattr(tmodule, "get_buffers", None)
+        if callable(getb):
+            buffers = dict(getb())
+        if train_step.opt_state is not None:
+            opt_like = train_step.opt_state
+        elif meta.get("has_opt_state"):
+            tparams = {k: v for k, v in params.items()
+                       if getattr(live_params[k], "requires_grad", True)}
+            opt_like = train_step.optimizer.init(tparams)
+        else:
+            opt_like = {}
+        like = {"params": params, "buffers": buffers, "opt_state": opt_like}
+        state = dist_ckpt.load(os.path.join(stepdir, _STATE_SUBDIR), like=like)
+        self._apply(train_step, state, meta)
+        _obs.event("checkpoint_restore", step=meta["step"], path=stepdir)
+        _obs.inc("checkpoint.restored")
+        ldr = loader or self.loader
+        if meta.get("loader") is not None and ldr is not None:
+            ldr.load_state_dict(meta["loader"])
+        return meta
+
+    def _apply(self, train_step, state: dict, meta: dict) -> None:
+        tmodule = train_step.tmodule
+        live = tmodule.get_parameters()
+        for k, v in state["params"].items():
+            p = live.get(k)
+            if p is None:
+                warnings.warn(f"checkpoint param {k!r} not in module; skipped",
+                              stacklevel=2)
+                continue
+            old = getattr(p, "data", None)
+            if old is not None and tuple(np.shape(v)) != tuple(old.shape):
+                raise CheckpointError(
+                    f"checkpoint shape mismatch for {k!r}: "
+                    f"{tuple(np.shape(v))} vs live {tuple(old.shape)}")
+            sharding = getattr(old, "sharding", None)
+            arr = jax.device_put(v, sharding) if sharding is not None else v
+            if hasattr(p, "data"):
+                p.data = arr
+        if state.get("buffers"):
+            mod = getattr(tmodule, "module", None) or getattr(tmodule, "_module", None)
+            slots = {q: (m, b) for q, m, b in mod.named_buffer_slots()} if mod is not None else {}
+            for k, v in state["buffers"].items():
+                if k in slots:
+                    m, b = slots[k]
+                    m._buffers[b] = v
+        if meta.get("has_opt_state"):
+            train_step.opt_state = state["opt_state"]
+        train_step._step_count = int(meta["step"])

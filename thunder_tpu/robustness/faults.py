@@ -1,0 +1,179 @@
+"""Deterministic fault injection: every recovery path is exercised, not trusted.
+
+A fault-tolerance layer that is only ever executed by real outages is a
+fault-tolerance layer that silently rots. This module lets tests (and brave
+operators) inject the four fault classes the robustness stack recovers from,
+at an exact step index, so each policy's observable outcome is pinned by CI:
+
+  nan_loss    poison the step's batch so the traced loss is genuinely NaN
+              (exercises the in-program finite gate + StepGuard policies)
+  transient   raise ``InjectedTransientError`` at dispatch time, N times
+              (exercises bounded retry-with-backoff)
+  ckpt_fail   raise ``InjectedCheckpointError`` inside the checkpoint write
+              (exercises non-fatal save failures / strict mode)
+  preempt     deliver a real SIGTERM to this process after the step completes
+              (exercises the PreemptionHandler -> final save -> Preempted path)
+
+Enablement:
+  TT_FAULT=nan_loss@5,transient@7*2,preempt@9    env knob, parsed at import
+  faults.configure("ckpt_fail@4")                the same, programmatically
+  faults.clear()                                 disarm (tests)
+
+``<kind>@<step>`` fires once at 0-based step index ``step``; ``*<count>``
+makes it fire at ``count`` consecutive opportunities starting there
+(``nan_loss@5*3`` poisons steps 5,6,7; ``transient@5*2`` fails the first two
+dispatch attempts of step 5 — retries within one step re-consult the plan).
+
+Zero-overhead discipline: with no plan configured (the default), the hot-path
+check is a single module-global ``is None`` test (``active()``), mirroring the
+disabled observability bus.
+"""
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+import numpy as np
+
+KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt")
+
+
+class InjectedTransientError(RuntimeError):
+    """A simulated transient executor/runtime failure (retryable)."""
+
+
+class InjectedCheckpointError(OSError):
+    """A simulated checkpoint-write failure."""
+
+
+class _Fault:
+    __slots__ = ("kind", "step", "count", "fired")
+
+    def __init__(self, kind: str, step: int, count: int = 1):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; expected one of {KINDS}")
+        if step < 0 or count < 1:
+            raise ValueError(f"fault {kind}@{step}*{count}: step must be >= 0, count >= 1")
+        self.kind = kind
+        self.step = step
+        self.count = count
+        self.fired = 0
+
+    def __repr__(self) -> str:
+        return f"{self.kind}@{self.step}*{self.count}(fired={self.fired})"
+
+
+class FaultPlan:
+    """Parsed TT_FAULT spec: an ordered list of armed faults."""
+
+    def __init__(self, faults: list[_Fault]):
+        self.faults = faults
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "@" not in part:
+                raise ValueError(
+                    f"bad TT_FAULT entry {part!r}: expected <kind>@<step>[*<count>]")
+            kind, _, rest = part.partition("@")
+            count = 1
+            if "*" in rest:
+                rest, _, cnt = rest.partition("*")
+                count = int(cnt)
+            faults.append(_Fault(kind.strip(), int(rest), count))
+        return cls(faults)
+
+    def should_fire(self, kind: str, step: int) -> bool:
+        """True (and consumes one firing) if a fault of `kind` is armed for
+        this step. A fault with count K fires at K consecutive opportunities
+        starting at its step index."""
+        for f in self.faults:
+            if f.kind != kind or f.fired >= f.count:
+                continue
+            if step >= f.step:
+                f.fired += 1
+                return True
+        return False
+
+    def pending(self) -> list[_Fault]:
+        return [f for f in self.faults if f.fired < f.count]
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.faults})"
+
+
+# module-global plan: None (the default) keeps every injection site at a
+# single global read — the same zero-work discipline as the disabled bus
+_PLAN: Optional[FaultPlan] = None
+
+
+def configure(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Arm a fault plan from a TT_FAULT-style spec (None/"" disarms)."""
+    global _PLAN
+    _PLAN = FaultPlan.parse(spec) if spec else None
+    return _PLAN
+
+
+def clear() -> None:
+    configure(None)
+
+
+def plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def active() -> bool:
+    """Hot-path gate: one module-global read."""
+    return _PLAN is not None
+
+
+def should_fire(kind: str, step: int) -> bool:
+    return _PLAN is not None and _PLAN.should_fire(kind, step)
+
+
+def maybe_raise(kind: str, step: int, exc_type=None) -> None:
+    """Raise the injected error for `kind` if armed for this step."""
+    if _PLAN is None or not _PLAN.should_fire(kind, step):
+        return
+    if exc_type is None:
+        exc_type = (InjectedCheckpointError if kind == "ckpt_fail"
+                    else InjectedTransientError)
+    raise exc_type(f"injected {kind} fault at step {step}")
+
+
+def maybe_poison(args: tuple, kwargs: dict, step: int):
+    """nan_loss site: scale the first float array leaf of the batch by NaN so
+    the traced loss is genuinely non-finite (the in-program finite gate and
+    the guard's host check both see the real thing, not a host-side fake)."""
+    if _PLAN is None or not _PLAN.should_fire("nan_loss", step):
+        return args, kwargs
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    for i, leaf in enumerate(leaves):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and np.issubdtype(np.dtype(dt), np.floating):
+            leaves[i] = leaf * np.float32(np.nan)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+    raise RuntimeError(
+        "nan_loss fault: the batch has no float array leaf to poison "
+        "(integer token batches cannot carry a NaN; poison a float input)")
+
+
+def maybe_preempt(step: int) -> None:
+    """preempt site: deliver a REAL SIGTERM to this process, exercising the
+    installed signal handler exactly as a TPU-fleet preemption notice would."""
+    if _PLAN is None or not _PLAN.should_fire("preempt", step):
+        return
+    signal.raise_signal(signal.SIGTERM)
+
+
+# env-driven arming at import (mirrors TT_OBS)
+_env_spec = os.environ.get("TT_FAULT")
+if _env_spec:
+    configure(_env_spec)

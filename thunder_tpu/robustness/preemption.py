@@ -1,0 +1,83 @@
+"""Preemption handling: turn SIGTERM into a drained step + final checkpoint.
+
+TPU fleets preempt; a preemption notice arrives as SIGTERM with a grace
+window. The handler here does the minimum safe thing inside the signal
+context — set a flag — and lets the training loop finish its in-flight step;
+``CheckpointManager.on_step`` then forces a final blocking save and raises
+``Preempted``. ``Preempted`` propagating uncaught is deliberate: it reaches
+``sys.excepthook``, so the flight recorder's crash hook
+(observability/flight_recorder.py install_crash_hook) still dumps the
+step-time ring for post-mortem triage — recovery is debuggable, not magical.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import warnings
+from typing import Optional
+
+from ..observability import events as _obs
+
+
+class Preempted(RuntimeError):
+    """Raised (from the step loop, never the signal context) after the final
+    checkpoint of a preempted run is durable. Carries ``checkpoint_path``."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 checkpoint_path: Optional[str] = None):
+        super().__init__(message)
+        self.step = step
+        self.checkpoint_path = checkpoint_path
+
+
+class PreemptionHandler:
+    """Chainable SIGTERM/SIGINT trap exposing a ``preempted`` event.
+
+    The handler body only sets the event and emits a bus event — signal
+    context is the wrong place for checkpoint IO or exceptions. A previously
+    installed *callable* handler is chained after ours (default/ignore
+    dispositions are NOT chained: the default SIGTERM disposition kills the
+    process instantly, which is exactly what a drained shutdown must avoid).
+    ``install`` outside the main thread degrades gracefully: signals cannot
+    be trapped there, but ``preempted`` can still be set programmatically.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self.preempted = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        first = not self.preempted.is_set()
+        self.preempted.set()
+        if first and _obs.enabled():
+            _obs.event("preempt_signal", signum=int(signum))
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self._installed = True
+        except ValueError:  # not the main thread: polling-only mode
+            warnings.warn(
+                "PreemptionHandler.install() outside the main thread cannot "
+                "trap signals; preemption must be signalled via "
+                "handler.preempted.set()", stacklevel=2)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev if prev is not None else signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False

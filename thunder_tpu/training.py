@@ -22,6 +22,8 @@ from .observability import events as _obs
 from .observability import flight_recorder as _obs_flight
 from .observability import metrics as _obs_metrics
 from .observability import runtime as _obs_runtime
+from .optim import global_norm as _global_norm
+from .robustness import faults as _rb_faults
 
 
 def _stable_val(v, depth: int = 0) -> str:
@@ -115,7 +117,8 @@ class TrainStep:
     loss_module: a Module whose forward(*batch) returns a scalar loss.
     """
 
-    def __init__(self, loss_module, optimizer, *, donate: bool = True, mesh_plan=None):
+    def __init__(self, loss_module, optimizer, *, donate: bool = True, mesh_plan=None,
+                 guard=None):
         from . import jit as _jit
 
         if isinstance(loss_module, Module):
@@ -126,6 +129,10 @@ class TrainStep:
         self.optimizer = optimizer
         self.donate = donate
         self.mesh_plan = mesh_plan  # set by parallel transforms for sharded steps
+        # robustness layer: a StepGuard changes the traced program (finite
+        # gate + grad-norm metric), so it is fixed at construction; the
+        # CheckpointManager attaches itself via manager.attach(step)
+        self._guard = guard
         self._jitted: Optional[Callable] = None
         self.opt_state = None
         self._step_count = 0
@@ -235,6 +242,13 @@ class TrainStep:
     def _build(self, batch_args, batch_kwargs):
         plan = getattr(self.tmodule, "_dist_plan", None)
         optimizer = self.optimizer
+        guard = self._guard
+        if guard is not None and plan is not None:
+            raise NotImplementedError(
+                "step guards are not supported with a distributed plan yet; "
+                "guard single-host steps, or rely on checkpoint/restart for "
+                "sharded runs")
+        check_gnorm = guard is not None and guard.policy.check_grad_norm
         vag = self._make_vag(sync_loss=True)
         self._vag = vag
 
@@ -250,14 +264,35 @@ class TrainStep:
             param_grads = grads[0][0]
             with _obs_runtime.fusion_scope("tt_optimizer"):
                 new_params, new_state = optimizer.update(tparam_arrays, param_grads, opt_state)
+            gmetrics = None
+            if guard is not None:
+                # in-program health gate: a non-finite loss/grad-norm step
+                # must leave params AND optimizer state untouched. This has
+                # to happen inside the program — under buffer donation the
+                # old arrays no longer exist anywhere the host could reach
+                # by the time it observes the loss.
+                gnorm = (_global_norm(param_grads) if check_gnorm
+                         else jnp.zeros((), jnp.float32))
+                finite = jnp.isfinite(loss)
+                if check_gnorm:
+                    finite = jnp.logical_and(finite, jnp.isfinite(gnorm))
+                new_params = {k: jnp.where(finite, v, tparam_arrays[k])
+                              for k, v in new_params.items()}
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_state, opt_state)
+                gmetrics = (finite, gnorm)
             pending = vag.consume_pending_effects()
             if pending is not None:
                 # epilogue values (buffer mutations) ride out as jit outputs;
                 # __call__ replays them onto the module after the step
                 train_step._effect_keys = pending[0]
-                return loss, new_params, new_state, pending[1]
-            train_step._effect_keys = None
-            return loss, new_params, new_state, ()
+                effects = pending[1]
+            else:
+                train_step._effect_keys = None
+                effects = ()
+            if guard is not None:
+                return loss, new_params, new_state, effects, gmetrics
+            return loss, new_params, new_state, effects
 
         # attribution hierarchy for device profiles: the whole-step program
         # is named (its HLO module becomes jit_tt_train_step — the join
@@ -301,6 +336,10 @@ class TrainStep:
             _safe_repr(self.optimizer),
             repr(self._active_mode),
             repr(self.donate),
+            # a guard changes the traced program (finite gate + metric
+            # outputs): a guarded and an unguarded step must never share an
+            # AOT entry
+            self._guard.program_key() if self._guard is not None else "noguard",
             "|".join(_safe_repr(t) for t in getattr(self.tmodule._cfn, "_transforms", ())),
         ])
         inputs = (tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
@@ -406,6 +445,36 @@ class TrainStep:
             frozen_arrays[k] = m._buffers[bn]
         return tparam_arrays, frozen_arrays, t_pairs
 
+    # set by CheckpointManager.attach(); None keeps the per-step cost at one
+    # attribute read (same discipline as the disabled observability bus)
+    _ckpt_manager = None
+
+    @property
+    def step_count(self) -> int:
+        """Completed optimizer steps; checkpoint/restore round-trips it."""
+        return self._step_count
+
+    def _dispatch(self, *jit_args):
+        """Invoke the compiled step, with bounded retry-with-backoff for
+        transient runtime errors when the guard asks for it (generalizing
+        the one-shot rebuild in _CompiledWithFallback, which stays the
+        first line of defense for stale AOT executables)."""
+        g = self._guard
+        step_idx = self._step_count
+        if g is None or g.policy.retry_transient <= 0:
+            if _rb_faults.active():
+                _rb_faults.maybe_raise("transient", step_idx)
+            return self._jitted(*jit_args)
+
+        def attempt():
+            # the injection point sits INSIDE the retry loop so an armed
+            # `transient@N*k` fault fails the first k attempts of step N
+            if _rb_faults.active():
+                _rb_faults.maybe_raise("transient", step_idx)
+            return self._jitted(*jit_args)
+
+        return g.run_with_retry(attempt, step=step_idx)
+
     def __call__(self, *args, **kwargs):
         # one enabled() read gates ALL per-step observability: disabled mode
         # (the default) must do zero event-bus work on the dispatch path.
@@ -418,6 +487,11 @@ class TrainStep:
         self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
             return self.micro_step(*args, **kwargs)
+        # fault-injection seam (TT_FAULT): with no plan armed this is one
+        # module-global read — the same zero-work contract as the bus
+        step_idx = self._step_count
+        if _rb_faults.active():
+            args, kwargs = _rb_faults.maybe_poison(args, kwargs, step_idx)
         tparam_arrays, frozen_arrays, t_pairs = self._split_arrays()
         if self.opt_state is None:
             self.opt_state = self.optimizer.init(tparam_arrays)
@@ -439,6 +513,7 @@ class TrainStep:
             # bus disabled this whole block is one boolean test.
             _obs.event("host_overhead", fn="train_step", step=self._step_count,
                        us=round((time.perf_counter_ns() - t_host) / 1e3, 2))
+        gmetrics = None
         if self._grad_acc is not None:
             # final (syncing) step of a no_sync accumulation window: fold the
             # accumulated local grads in before the optimizer update
@@ -456,12 +531,23 @@ class TrainStep:
             # Gated on the obs_on read from call entry: the disabled-mode
             # steady-state path must not call into the observability layer
             with _obs.span("train_step") if sampled else _NULL_SPAN:
-                loss, new_params, self.opt_state, effects = self._jitted(
+                out = self._dispatch(
                     tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            if self._guard is not None:
+                loss, new_params, self.opt_state, effects, gmetrics = out
+            else:
+                loss, new_params, self.opt_state, effects = out
+                gmetrics = None
             if effects and getattr(self, "_effect_keys", None):
-                # epilogue: replay traced buffer mutations (running stats)
-                for (owner, name), v in zip(self._effect_keys, effects):
-                    owner._buffers[name] = v
+                # epilogue: replay traced buffer mutations (running stats).
+                # Under a guard, a non-finite step must not replay either:
+                # the effect values were computed from the NaN forward, and
+                # poisoned running stats / amax histories would corrupt
+                # every later step the param gate just protected. The
+                # bool() sync is one the guard's after_step pays anyway.
+                if gmetrics is None or bool(gmetrics[0]):
+                    for (owner, name), v in zip(self._effect_keys, effects):
+                        owner._buffers[name] = v
         for k, p in t_pairs:
             p.data = new_params[k]
         self._step_count += 1
@@ -472,6 +558,17 @@ class TrainStep:
             _obs_flight.record_step(
                 (time.perf_counter_ns() - t_host) / 1e6,
                 step=self._step_count, fn="train_step")
+        if gmetrics is not None:
+            # host half of the guard: one device sync, then policy
+            # (raise / skip-with-budget / rollback via the manager)
+            self._guard.after_step(self, loss, gmetrics)
+        if _rb_faults.active():
+            _rb_faults.maybe_preempt(step_idx)
+        mgr = self._ckpt_manager
+        if mgr is not None:
+            # periodic save / preemption drain; idle cost is an Event read
+            # plus an int modulo (see CheckpointManager.on_step)
+            mgr.on_step(self)
         return loss
 
     # -- gradient accumulation (reference ThunderModule.no_sync,
@@ -488,6 +585,14 @@ class TrainStep:
         gradients ride in a device-axis-sharded accumulator, so a K-step
         window costs ONE all-reduce instead of K (reference no_sync +
         _sync_grads, thunder/distributed/__init__.py:36,118)."""
+        if self._guard is not None:
+            # the window's fold step applies the optimizer update through a
+            # separate program with no finite gate — silently un-guarding
+            # the only updating step of a window would fake NaN protection
+            raise NotImplementedError(
+                "step guards are not supported inside no_sync gradient-"
+                "accumulation windows yet; step without no_sync, or drop "
+                "the guard")
         self._sync_mode()
         plan = getattr(self.tmodule, "_dist_plan", None)
         if plan is not None:

@@ -182,11 +182,19 @@ def spike_lines(recs: list[dict]) -> list[str]:
     for r in spikes[-10:]:
         a = r.get("attrs", {})
         ratio = a.get("ratio")
+        detail = ""
+        if a.get("reason"):
+            detail = f" reason={a['reason']}"
+        elif a.get("cause") == "checkpoint-save":
+            # name the overlapping save so checkpoint stalls stop reading as
+            # anonymous spikes (save_ms is absent while the write is in flight)
+            detail = f" ckpt_step={a.get('ckpt_step')}"
+            if a.get("save_ms") is not None:
+                detail += f" save_ms={a['save_ms']}"
         lines.append(
             f"  step {a.get('step', '?'):>6}  {a.get('wall_ms', '?')}ms "
             f"({ratio}x median {a.get('median_ms', '?')}ms)  "
-            f"cause={a.get('cause', 'unknown')}"
-            + (f" reason={a['reason']}" if a.get("reason") else ""))
+            f"cause={a.get('cause', 'unknown')}" + detail)
     return lines
 
 
