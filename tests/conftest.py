@@ -40,6 +40,11 @@ def pytest_configure(config):
         "markers",
         "fault: fault-injection test (exercises TT_FAULT recovery paths; "
         "filter with -m fault / -m 'not fault')")
+    config.addinivalue_line(
+        "markers",
+        "serve: serving-engine test (continuous batching + paged KV cache; "
+        "runs under JAX_PLATFORMS=cpu interpret mode in tier-1; filter with "
+        "-m serve / -m 'not serve')")
 
 
 def pytest_collection_modifyitems(config, items):

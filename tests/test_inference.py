@@ -153,14 +153,154 @@ def test_quantized_engine_generate_shapes(rng):
     assert ((toks >= 0) & (toks < cfg.padded_vocab_size)).all()
 
 
-def test_generation_past_block_size_consistent(rng):
-    """The engine sizes its KV cache to prompt+new tokens (rope is computed
-    per position, not table-capped at block_size); scan and per-step decode
-    must agree out there too."""
+def test_overlong_generation_raises(rng):
+    """prompt_len + max_new_tokens > max_seq must fail up front: letting it
+    run would have dynamic_update_slice clamp its writes at the cache edge
+    and silently corrupt the KV tail (the old behavior)."""
     cfg = Config.from_name("tiny", block_size=16)
     engine = GPTInference(GPT(cfg, dtype=jnp.float32), dtype=jnp.float32)
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 14)))
-    out_scan, _ = engine.generate(prompt, 10, scan_decode=True)
-    out_loop, _ = engine.generate(prompt, 10, scan_decode=False)
-    assert out_scan.shape == (1, 24)
+    with pytest.raises(ValueError, match="max_seq"):
+        engine.generate(prompt, 10)
+    # the boundary itself is fine: prompt + new == max_seq
+    out_scan, _ = engine.generate(prompt, 2, scan_decode=True)
+    out_loop, _ = engine.generate(prompt, 2, scan_decode=False)
+    assert out_scan.shape == (1, 16)
     np.testing.assert_array_equal(np.asarray(out_scan), np.asarray(out_loop))
+
+
+def test_gqa_scan_decode_matches_eager(rng):
+    """GQA config (n_query_groups != n_head): one-dispatch scan decode and
+    the eager per-step loop must produce identical token streams."""
+    cfg = Config(name="gqa-test", block_size=64, vocab_size=256,
+                 padded_vocab_size=256, n_layer=2, n_head=8, n_query_groups=2,
+                 n_embd=64, norm_class_name="RMSNorm", mlp_class_name="LLaMAMLP")
+    assert cfg.n_query_groups != cfg.n_head
+    engine = GPTInference(GPT(cfg, dtype=jnp.float32), dtype=jnp.float32)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 10)), jnp.int32)
+    out_scan, _ = engine.generate(prompt, 8, scan_decode=True)
+    out_loop, _ = engine.generate(prompt, 8, scan_decode=False)
+    assert out_scan.shape == (2, 18)
+    np.testing.assert_array_equal(np.asarray(out_scan), np.asarray(out_loop))
+
+
+def test_seeded_sampling_reproducible_and_per_seed(rng):
+    """seed= keys the sampling stream: same seed -> identical tokens,
+    different seeds -> (overwhelmingly) different draws (the old
+    PRNGKey(pos) scheme drew the SAME stream for every request)."""
+    cfg = Config.from_name("tiny", block_size=64)
+    engine = GPTInference(GPT(cfg, dtype=jnp.float32), dtype=jnp.float32)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 6)))
+    out_a1, _ = engine.generate(prompt, 12, temperature=1.0, seed=7)
+    out_a2, _ = engine.generate(prompt, 12, temperature=1.0, seed=7)
+    out_b, _ = engine.generate(prompt, 12, temperature=1.0, seed=8)
+    np.testing.assert_array_equal(np.asarray(out_a1), np.asarray(out_a2))
+    assert (np.asarray(out_a1) != np.asarray(out_b)).any()
+
+
+# ---------------------------------------------------------------------------
+# paged-vs-dense attention equivalence (serving substrate)
+# ---------------------------------------------------------------------------
+
+
+def _paged_fixture(rng, B=3, H=4, Hkv=2, D=16, ps=8, P=12, npm=4, dtype=jnp.float32):
+    """Random pool + ragged page tables, incl. partially-filled last pages."""
+    k_pages = jnp.asarray(rng.randn(P, ps, Hkv, D), dtype)
+    v_pages = jnp.asarray(rng.randn(P, ps, Hkv, D), dtype)
+    seq_lens = np.asarray([5, 17, 24], np.int32)  # partial, partial, full
+    pt = np.zeros((B, npm), np.int32)
+    pt[0, :1] = [3]
+    pt[1, :3] = [1, 4, 7]
+    pt[2, :3] = [2, 5, 9]
+    q = jnp.asarray(rng.randn(B, H, D), dtype)
+    return q, k_pages, v_pages, jnp.asarray(pt), jnp.asarray(seq_lens)
+
+
+def _dense_from_pages(q, k_pages, v_pages, pt, seq_lens):
+    """Gather each sequence's pages densely and run cached_sdpa (the dense
+    decode-attention reference) over its exact length."""
+    from thunder_tpu.inference import cached_sdpa
+
+    B, H, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    g = H // Hkv
+    dense = tt.jit(lambda q4, k4, v4, pos: cached_sdpa(q4, k4, v4, pos))
+    outs = []
+    for b in range(int(B)):
+        L = int(seq_lens[b])
+        npg = -(-L // ps)
+        row = np.asarray(pt)[b, :npg]
+        k = np.asarray(k_pages)[row].reshape(npg * ps, Hkv, D)[:L]
+        v = np.asarray(v_pages)[row].reshape(npg * ps, Hkv, D)[:L]
+        k = jnp.asarray(np.repeat(k.transpose(1, 0, 2), g, 0)[None])  # (1, H, L, D)
+        v = jnp.asarray(np.repeat(v.transpose(1, 0, 2), g, 0)[None])
+        q4 = jnp.asarray(np.asarray(q)[b][None, :, None, :])  # (1, H, 1, D)
+        # the query is the LAST cached token: cached_sdpa's mask needs its
+        # position, L-1
+        o = dense(q4, k, v, jnp.asarray(L - 1, jnp.int32))
+        outs.append(np.asarray(o)[0, :, 0, :])
+    return np.stack(outs)
+
+
+def test_paged_attention_reference_matches_dense(rng):
+    """ltorch.paged_attention's gather decomposition == dense cached_sdpa
+    over ragged page tables with partially-filled last pages."""
+    from thunder_tpu.ops import ltorch
+
+    q, kp, vp, pt, sl = _paged_fixture(rng)
+    paged = tt.jit(lambda q, kp, vp, pt, sl: ltorch.paged_attention(q, kp, vp, pt, sl))
+    out = np.asarray(paged(q, kp, vp, pt, sl))
+    ref = _dense_from_pages(q, kp, vp, pt, sl)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_attention_kernel_matches_dense(rng):
+    """The pallas paged decode kernel (interpret mode on CPU) == dense
+    cached_sdpa within tolerance — incl. GQA grouping and partial pages."""
+    from thunder_tpu.executors.pallasex import paged_attention_decode
+
+    q, kp, vp, pt, sl = _paged_fixture(rng)
+    out = np.asarray(paged_attention_decode(q, kp, vp, pt, sl, interpret=True))
+    ref = _dense_from_pages(q, kp, vp, pt, sl)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_attention_kernel_bf16_tolerance(rng):
+    """bf16 pool/query: kernel and reference agree within bf16 tolerance
+    (the acceptance bar: paged decode matches dense within bf16 eps)."""
+    from thunder_tpu.executors.pallasex import paged_attention_decode
+
+    q, kp, vp, pt, sl = _paged_fixture(rng, dtype=jnp.bfloat16)
+    out = np.asarray(paged_attention_decode(q, kp, vp, pt, sl, interpret=True),
+                     dtype=np.float32)
+    ref = _dense_from_pages(jnp.asarray(q, jnp.float32),
+                            jnp.asarray(kp, jnp.float32),
+                            jnp.asarray(vp, jnp.float32), pt, sl)
+    np.testing.assert_allclose(out, ref, atol=2e-2, rtol=2e-2)
+
+
+def test_paged_attention_vmem_fallback_declines():
+    """The ADVICE VMEM-estimation pattern: a page_size x D working set over
+    the budget makes the checker decline (the jax gather decomposition runs
+    instead of a kernel that would fail to fit VMEM)."""
+    import os
+
+    from thunder_tpu.executors import pallasex
+
+    class _P:
+        def __init__(self, shape, dtype="float32"):
+            self.shape = shape
+            self.ndim = len(shape)
+            self.dtype = dtype
+
+    q = _P((2, 4, 512))
+    small = _P((8, 32, 2, 512))
+    huge = _P((8, 8192, 2, 512))  # 2 * 2 * 8192*512*4B ≈ 67 MB of k/v blocks
+    pt = _P((2, 4), "int32")
+    sl = _P((2,), "int32")
+    os.environ["TT_PAGED_KERNEL"] = "1"
+    try:
+        assert pallasex.paged_attention_supported(q, small, small, pt, sl)
+        assert not pallasex.paged_attention_supported(q, huge, huge, pt, sl)
+    finally:
+        del os.environ["TT_PAGED_KERNEL"]

@@ -17,6 +17,7 @@ SCRIPTS = {
     "interpreter_frontend.py": [],
     "serving_quantized.py": ["int8"],
     "serving_quantized_nf4": None,  # alias row, resolved below
+    "continuous_batching.py": [],
     "distributed_fsdp.py": [],
     "gspmd_training.py": [],
     "fp8_training.py": [],

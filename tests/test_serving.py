@@ -1,0 +1,447 @@
+"""Serving engine: continuous batching + paged KV cache correctness.
+
+The engine contract under test: every request decoded under continuous
+batching produces EXACTLY the token stream it would produce running solo
+through the dense GPTInference engine — whatever mix of lengths, slots, and
+admission waits it experienced — and a finished request's pages return to
+the pool immediately. Runs entirely under JAX_PLATFORMS=cpu (conftest);
+the pallas paged kernel path is covered in interpret mode by
+tests/test_inference.py's equivalence tests.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.inference import GPTInference
+from thunder_tpu.models.litgpt import Config, GPT
+from thunder_tpu.serving import OutOfPages, PageAllocator, PagedKVCache, ServingEngine
+from thunder_tpu.serving.runner import bucket_len
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    cfg = Config.from_name("tiny-llama2", block_size=64)
+    return GPT(cfg, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def dense(gpt):
+    return GPTInference(gpt, dtype=jnp.float32)
+
+
+def _engine(gpt, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("dtype", jnp.float32)
+    return ServingEngine(gpt, **kw)
+
+
+# ---------------------------------------------------------------------------
+# allocator / page-pool unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_freelist_roundtrip():
+    a = PageAllocator(8)  # 7 usable + null
+    assert a.n_free == 7
+    got = a.alloc(5)
+    assert len(set(got)) == 5 and 0 not in got
+    assert a.n_used == 5
+    with pytest.raises(OutOfPages):
+        a.alloc(3)
+    a.free(got[:2])
+    assert a.n_free == 4
+    with pytest.raises(ValueError):
+        a.free([got[0]])  # double free
+    with pytest.raises(ValueError):
+        a.free([0])  # the null page is never allocatable
+
+
+def test_page_table_row_pads_with_null():
+    cache = PagedKVCache(1, 8, 4, 2, 8, jnp.float32)
+    row = cache.page_table_row([3, 5], 4)
+    assert row.tolist() == [3, 5, 0, 0]
+
+
+def test_bucket_len_powers_of_two():
+    assert bucket_len(1, minimum=8, maximum=64) == 8
+    assert bucket_len(8, minimum=8, maximum=64) == 8
+    assert bucket_len(9, minimum=8, maximum=64) == 16
+    assert bucket_len(33, minimum=8, maximum=64) == 64
+    assert bucket_len(200, minimum=8, maximum=64) == 64  # capped
+
+
+# ---------------------------------------------------------------------------
+# engine correctness vs the dense solo engine
+# ---------------------------------------------------------------------------
+
+
+def test_single_request_matches_dense(gpt, dense, rng):
+    engine = _engine(gpt)
+    prompt = rng.randint(0, gpt.cfg.vocab_size, (9,)).astype(np.int32)
+    fut = engine.submit(prompt, max_new_tokens=6)
+    engine.drain()
+    res = fut.result()
+    out, _ = dense.generate(jnp.asarray(prompt[None, :]), 6, scan_decode=False)
+    np.testing.assert_array_equal(res.new_tokens, np.asarray(out)[0, 9:])
+    assert res.tokens.shape == (15,)
+    assert res.finish_reason == "length"
+    assert res.ttft_s > 0 and res.tbot_s > 0
+
+
+def test_concurrent_mixed_lengths_match_dense(gpt, dense, rng):
+    """More requests than decode slots, mixed prompt/output lengths: every
+    stream must equal its solo dense decode (slot reuse + admission waits
+    must not perturb any sequence)."""
+    engine = _engine(gpt)
+    shapes = [(5, 7), (13, 4), (9, 10), (20, 3), (3, 8), (11, 5)]
+    reqs = []
+    for L, n in shapes:
+        p = rng.randint(0, gpt.cfg.vocab_size, (L,)).astype(np.int32)
+        reqs.append((p, n, engine.submit(p, max_new_tokens=n)))
+    engine.drain()
+    for p, n, fut in reqs:
+        res = fut.result()
+        out, _ = dense.generate(jnp.asarray(p[None, :]), n, scan_decode=False)
+        np.testing.assert_array_equal(res.new_tokens, np.asarray(out)[0, len(p):])
+    # all pages returned at retirement
+    assert engine.cache.allocator.n_used == 0
+    assert engine.stats()["page_pool_utilization"] == 0.0
+
+
+def test_temperature_stream_matches_dense_seeded(gpt, dense, rng):
+    """Position-keyed sampling: the same (seed, temperature) request draws
+    the identical stream solo or continuously batched."""
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (7,)).astype(np.int32)
+    fut = engine.submit(p, max_new_tokens=8, temperature=0.9, seed=42)
+    # a concurrent greedy request keeps the batch genuinely mixed
+    other = engine.submit(rng.randint(0, gpt.cfg.vocab_size, (12,)).astype(np.int32),
+                          max_new_tokens=5)
+    engine.drain()
+    res = fut.result()
+    other.result()
+    out, _ = dense.generate(jnp.asarray(p[None, :]), 8, temperature=0.9,
+                            seed=42, scan_decode=False)
+    np.testing.assert_array_equal(res.new_tokens, np.asarray(out)[0, 7:])
+
+
+def test_eos_retires_early_and_frees_pages(gpt, rng):
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    # find the greedy continuation's second token, then use it as eos
+    probe = engine.submit(p, max_new_tokens=3)
+    engine.drain()
+    tok2 = int(probe.result().new_tokens[1])
+    fut = engine.submit(p, max_new_tokens=30, eos_id=tok2)
+    engine.drain()
+    res = fut.result()
+    assert res.finish_reason == "eos"
+    assert res.n_new_tokens == 2  # stopped at eos, 28 tokens early
+    assert engine.cache.allocator.n_used == 0
+
+
+def test_admission_waits_for_pages_then_completes(gpt, dense, rng):
+    """A pool sized for ~one sequence forces head-of-line waiting; both
+    requests must still complete correctly (pages return at retirement)."""
+    # 9 usable pages: one (L=9, n=7) request needs bucket 16/8=2 prefill
+    # pages and ceil(16/8)=2 worst-case -> 2; three requests need 6; size
+    # the pool so only one fits at a time
+    engine = _engine(gpt, n_pages=4)
+    reqs = []
+    for _ in range(3):
+        p = rng.randint(0, gpt.cfg.vocab_size, (9,)).astype(np.int32)
+        reqs.append((p, engine.submit(p, max_new_tokens=7)))
+    engine.drain()
+    for p, fut in reqs:
+        res = fut.result()
+        out, _ = dense.generate(jnp.asarray(p[None, :]), 7, scan_decode=False)
+        np.testing.assert_array_equal(res.new_tokens, np.asarray(out)[0, 9:])
+    assert engine.cache.allocator.n_used == 0
+
+
+def test_inadmissible_requests_fail_fast(gpt, rng):
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (60,)).astype(np.int32)
+    with pytest.raises(ValueError, match="max_seq"):
+        engine.submit(p, max_new_tokens=10).result()  # 60 + 10 > 64
+    small = _engine(gpt, n_pages=3)  # 2 usable pages
+    big = rng.randint(0, gpt.cfg.vocab_size, (40,)).astype(np.int32)
+    with pytest.raises(ValueError, match="pages"):
+        small.submit(big, max_new_tokens=8).result()
+
+
+def test_background_thread_driver(gpt, dense, rng):
+    """submit() from the caller thread while the loop runs in background."""
+    engine = _engine(gpt)
+    engine.start()
+    try:
+        p = rng.randint(0, gpt.cfg.vocab_size, (8,)).astype(np.int32)
+        res = engine.submit(p, max_new_tokens=5).result(timeout=120)
+        out, _ = dense.generate(jnp.asarray(p[None, :]), 5, scan_decode=False)
+        np.testing.assert_array_equal(res.new_tokens, np.asarray(out)[0, 8:])
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# steady-state compile behavior + observability
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_state_recompiles(gpt, rng):
+    """After warming the decode step and each prompt bucket, a fresh wave of
+    mixed-length requests must trigger ZERO reason-coded recompile events —
+    the acceptance bar for shape-bucketed continuous batching."""
+    from thunder_tpu import observability
+
+    engine = _engine(gpt)
+    engine.warmup([3, 9, 17], max_new_tokens=2)  # buckets 8, 16, 32
+    observability.enable()
+    observability.reset()
+    try:
+        reqs = []
+        for L, n in [(4, 5), (10, 3), (18, 6), (7, 4), (15, 7)]:
+            p = rng.randint(0, gpt.cfg.vocab_size, (L,)).astype(np.int32)
+            reqs.append(engine.submit(p, max_new_tokens=n))
+        engine.drain()
+        for fut in reqs:
+            fut.result()
+        counters = observability.counters()
+        recompiles = {k: v for k, v in counters.items() if k.startswith("recompile.")}
+        assert not recompiles, f"steady state recompiled: {recompiles}"
+        assert counters.get("serve.requests", 0) == 5
+        assert counters.get("serve.retired", 0) == 5
+        assert counters.get("serve.decode_steps", 0) > 0
+        assert counters.get("serve.tokens", 0) == sum(n - 1 for _, n in
+                                                      [(4, 5), (10, 3), (18, 6), (7, 4), (15, 7)])
+    finally:
+        observability.disable()
+        observability.reset()
+
+
+def test_request_spans_and_retire_events(gpt, rng):
+    """Per-request observability: request-id-tagged prefill spans and
+    serve_retired events with TTFT/TBOT land on the bus."""
+    from thunder_tpu import observability
+
+    engine = _engine(gpt)
+    observability.enable()
+    observability.reset()
+    try:
+        p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+        rid = None
+        fut = engine.submit(p, max_new_tokens=4)
+        engine.drain()
+        rid = fut.result().request_id
+        recs = observability.records()
+        prefills = [r for r in recs if r["kind"] == "span" and r["name"] == "serve_prefill"]
+        assert any(r["attrs"].get("request") == rid for r in prefills)
+        retires = [r for r in recs if r["kind"] == "event" and r["name"] == "serve_retired"]
+        assert len(retires) == 1
+        attrs = retires[0]["attrs"]
+        assert attrs["request"] == rid and attrs["n_new"] == 4
+        assert attrs["ttft_ms"] > 0 and attrs["tbot_ms"] > 0
+        decodes = [r for r in recs if r["kind"] == "span" and r["name"] == "serve_decode"]
+        assert decodes and all(r["attrs"]["active"] >= 1 for r in decodes)
+    finally:
+        observability.disable()
+        observability.reset()
+
+
+def test_prefill_bucket_mru_promotes(gpt, rng):
+    """The serving engine rides the interpreter frontend's ShapeKeyedMRU:
+    the bucket that just served probes first."""
+    engine = _engine(gpt)
+    for L in (3, 20):  # buckets 8, 32
+        engine.submit(rng.randint(0, gpt.cfg.vocab_size, (L,)).astype(np.int32), 2)
+    engine.drain()
+    assert engine.stats()["prefill_buckets"] == [32, 8]
+    engine.submit(rng.randint(0, gpt.cfg.vocab_size, (4,)).astype(np.int32), 2)
+    engine.drain()
+    assert engine.stats()["prefill_buckets"] == [8, 32]
+
+
+def test_prefill_failure_contained(gpt, dense, rng):
+    """A request whose compiled step raises must fail ITS Future, return its
+    pages, and leave the engine serving later requests — not kill the loop
+    and hang every waiter."""
+    engine = _engine(gpt)
+    orig = engine.runner.prefill_cfn
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected prefill failure")
+
+    engine.runner.prefill_cfn = boom
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    fut = engine.submit(p, max_new_tokens=4)
+    engine.drain()
+    with pytest.raises(RuntimeError, match="injected"):
+        fut.result(timeout=5)
+    assert engine.cache.allocator.n_used == 0  # pages returned
+    engine.runner.prefill_cfn = orig
+    ok = engine.submit(p, max_new_tokens=4)
+    engine.drain()
+    out, _ = dense.generate(jnp.asarray(p[None, :]), 4, scan_decode=False)
+    np.testing.assert_array_equal(ok.result().new_tokens, np.asarray(out)[0, 6:])
+
+
+def test_decode_failure_fails_active_batch(gpt, rng):
+    """A failing packed decode step fails every implicated Future and frees
+    their pages; the engine stays usable."""
+    engine = _engine(gpt)
+    p1 = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    p2 = rng.randint(0, gpt.cfg.vocab_size, (10,)).astype(np.int32)
+    f1 = engine.submit(p1, max_new_tokens=8)
+    f2 = engine.submit(p2, max_new_tokens=8)
+    orig = engine.runner.decode_cfn
+    engine.runner.decode_cfn = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("injected decode failure"))
+    engine.drain()
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError, match="injected"):
+            f.result(timeout=5)
+    assert engine.cache.allocator.n_used == 0
+    engine.runner.decode_cfn = orig
+    ok = engine.submit(p1, max_new_tokens=3)
+    engine.drain()
+    assert ok.result().n_new_tokens == 3
+
+
+def test_seed_canonicalized_mod_2_32(gpt, dense, rng):
+    """Seeds outside [0, 2^32) draw the same stream as seed % 2^32 in BOTH
+    engines (the packed sampler array is uint32)."""
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    engine = _engine(gpt)
+    f_big = engine.submit(p, 6, temperature=1.0, seed=(1 << 32) + 5)
+    f_small = engine.submit(p, 6, temperature=1.0, seed=5)
+    engine.drain()
+    np.testing.assert_array_equal(f_big.result().new_tokens,
+                                  f_small.result().new_tokens)
+    out_big, _ = dense.generate(jnp.asarray(p[None, :]), 6, temperature=1.0,
+                                seed=(1 << 32) + 5, scan_decode=False)
+    out_small, _ = dense.generate(jnp.asarray(p[None, :]), 6, temperature=1.0,
+                                  seed=5, scan_decode=False)
+    np.testing.assert_array_equal(np.asarray(out_big), np.asarray(out_small))
+    np.testing.assert_array_equal(f_big.result().new_tokens,
+                                  np.asarray(out_big)[0, 6:])
+
+
+def test_cancelled_future_does_not_wedge_engine(gpt, dense, rng):
+    """fut.cancel() must not blow up retirement or leave a slot stuck:
+    queued cancellations are dropped before allocation, in-flight ones
+    retire at the next step with pages freed, and later requests serve."""
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    queued = engine.submit(p, max_new_tokens=4)
+    assert queued.cancel()  # still pending -> cancellable
+    live = engine.submit(p, max_new_tokens=4)
+    engine.drain()
+    assert queued.cancelled()
+    out, _ = dense.generate(jnp.asarray(p[None, :]), 4, scan_decode=False)
+    np.testing.assert_array_equal(live.result().new_tokens, np.asarray(out)[0, 6:])
+    # in-flight cancel: admit, then cancel mid-decode via inline stepping
+    f = engine.submit(p, max_new_tokens=30)
+    engine._step_once()  # admits + first decode step
+    assert f.cancel()  # engine futures are never set_running
+    engine.drain()
+    assert engine.cache.allocator.n_used == 0  # pages freed either way
+    again = engine.submit(p, max_new_tokens=3)
+    engine.drain()
+    assert again.result().n_new_tokens == 3
+
+
+def test_misaligned_min_bucket_rejected(gpt):
+    with pytest.raises(ValueError, match="min_bucket"):
+        _engine(gpt, min_bucket=20)  # not a multiple of page_size=8
+
+
+def test_intra_call_duplicate_free_rejected():
+    a = PageAllocator(8)
+    got = a.alloc(2)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[0], got[0]])
+    a.free(got)  # the failed call must not have mutated anything
+    assert a.n_free == 7
+
+
+def test_stop_fails_outstanding_futures(gpt, rng):
+    """stop() must not strand waiters: whatever is still queued or
+    in-flight fails with a clear error and its pages come back."""
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    inflight = engine.submit(p, max_new_tokens=30)
+    engine._step_once()  # admit + one decode step
+    queued = engine.submit(p, max_new_tokens=4)
+    engine.stop()
+    for f in (inflight, queued):
+        with pytest.raises(RuntimeError, match="stopped"):
+            f.result(timeout=5)
+    assert engine.cache.allocator.n_used == 0
+
+
+def test_submit_after_stop_fails_fast(gpt, rng):
+    engine = _engine(gpt)
+    engine.start()
+    engine.stop()
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    with pytest.raises(RuntimeError, match="stopped"):
+        engine.submit(p, max_new_tokens=3).result(timeout=5)
+    engine.start()  # restartable
+    try:
+        assert engine.submit(p, max_new_tokens=3).result(timeout=120).n_new_tokens == 3
+    finally:
+        engine.stop()
+
+
+def test_drain_with_running_thread_only_waits(gpt, dense, rng):
+    """drain() alongside the background thread must wait, not step inline
+    (inline stepping would race the thread over slots/pool state)."""
+    engine = _engine(gpt)
+    engine.start()
+    try:
+        p = rng.randint(0, gpt.cfg.vocab_size, (7,)).astype(np.int32)
+        fut = engine.submit(p, max_new_tokens=5)
+        engine.drain()
+        assert fut.done()
+        out, _ = dense.generate(jnp.asarray(p[None, :]), 5, scan_decode=False)
+        np.testing.assert_array_equal(fut.result().new_tokens, np.asarray(out)[0, 7:])
+    finally:
+        engine.stop()
+
+
+def test_index_put_negative_indices_normalized(rng):
+    """The multi-index linearization canonicalizes numpy-style negative
+    indices per-dim (a raw -1 would address the previous row's last slot)."""
+    from thunder_tpu.ops import ltorch
+
+    a = jnp.zeros((4, 8, 3), jnp.float32)
+    vals = jnp.asarray(rng.randn(2, 3), jnp.float32)
+    f = tt.jit(lambda a, i0, i1, v: ltorch.index_put(a, (i0, i1), v))
+    out = f(a, jnp.asarray([1, 2], jnp.int32), jnp.asarray([-1, 0], jnp.int32), vals)
+    ref = np.zeros((4, 8, 3), np.float32)
+    ref[1, -1] = np.asarray(vals)[0]
+    ref[2, 0] = np.asarray(vals)[1]
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_moe_serving_matches_dense(rng):
+    """The engine drives the MoE decoder too (block plumbing parity with
+    inference._forward_cached)."""
+    from thunder_tpu.models.moe import MoEConfig, MoEGPT
+
+    cfg = Config.from_name("tiny-llama2", block_size=64)
+    moe_cfg = MoEConfig(n_embd=cfg.n_embd, intermediate_size=160,
+                        n_expert=4, n_expert_per_token=2)
+    gpt = MoEGPT(cfg, moe_cfg, dtype=jnp.float32)
+    engine = _engine(gpt)
+    dense = GPTInference(gpt, dtype=jnp.float32)
+    p = rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+    fut = engine.submit(p, max_new_tokens=5)
+    engine.drain()
+    out, _ = dense.generate(jnp.asarray(p[None, :]), 5, scan_decode=False)
+    np.testing.assert_array_equal(fut.result().new_tokens, np.asarray(out)[0, 8:])

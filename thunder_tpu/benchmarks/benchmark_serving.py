@@ -1,0 +1,138 @@
+"""Serving load benchmark: open-loop streams against the continuous-batching
+engine (thunder_tpu/serving/), reporting aggregate tokens/sec, TTFT/TBOT
+p50/p99, page-pool utilization, and the steady-state recompile count.
+
+The load generator is OPEN-LOOP (Orca/vLLM evaluation style): request
+arrival times are drawn up front from an exponential inter-arrival process
+and requests are submitted on that schedule whatever the engine's backlog —
+so queueing delay shows up in TTFT instead of being hidden by a closed loop.
+Prompt and output lengths are drawn uniformly from mixed ranges.
+
+Usage:
+    python -m thunder_tpu.benchmarks.benchmark_serving --model_name tiny-llama2 \
+        --streams 8 --page_size 16 --arrival_rate 16
+    BENCH_SERVE=1 python -m thunder_tpu.benchmarks.benchmark_serving ...
+        # additionally writes the BENCH_SERVE.json artifact row
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def run(args) -> dict:
+    from thunder_tpu import observability
+    from thunder_tpu.models.litgpt import Config, GPT
+    from thunder_tpu.serving import ServingEngine
+
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    cfg = Config.from_name(args.model_name, block_size=max(args.max_seq, 128))
+    gpt = GPT(cfg, dtype=dtype)
+    engine = ServingEngine(gpt, max_batch=args.max_batch, page_size=args.page_size,
+                           max_seq=args.max_seq, dtype=dtype)
+
+    rng = np.random.RandomState(args.seed)
+    lens = [(int(rng.randint(args.prompt_len_min, args.prompt_len_max + 1)),
+             int(rng.randint(args.new_tokens_min, args.new_tokens_max + 1)))
+            for _ in range(args.streams)]
+    # exponential inter-arrivals -> open-loop schedule (seconds from t0)
+    gaps = rng.exponential(1.0 / args.arrival_rate, size=args.streams)
+    arrivals = np.cumsum(gaps) - gaps[0]
+
+    observability.enable()
+    # warm every bucket the workload will touch plus the decode step, then
+    # clear the counters: any recompile recorded after this point is a
+    # steady-state failure
+    engine.warmup(sorted({L for L, _ in lens}), max_new_tokens=2)
+    observability.reset()
+
+    engine.start()
+    t0 = time.perf_counter()
+    futs = []
+    try:
+        for (L, n), at in zip(lens, arrivals):
+            dt = t0 + float(at) - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            prompt = rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+            futs.append(engine.submit(prompt, max_new_tokens=n,
+                                      temperature=args.temperature,
+                                      seed=int(rng.randint(1 << 30))))
+        results = [f.result(timeout=600) for f in futs]
+    finally:
+        engine.stop()
+    wall = time.perf_counter() - t0
+
+    counters = observability.counters()
+    observability.disable()
+    recompiles = sum(v for k, v in counters.items() if k.startswith("recompile."))
+
+    import jax
+
+    total_new = sum(r.n_new_tokens for r in results)
+    ttfts = [r.ttft_s * 1e3 for r in results]
+    tbots = [r.tbot_s * 1e3 for r in results if r.n_new_tokens > 1]
+    stats = engine.stats()
+    row = {
+        "platform": jax.devices()[0].platform,
+        "metric": (f"{args.model_name} serving aggregate new tokens/sec "
+                   f"({args.streams} open-loop streams, max_batch={args.max_batch}, "
+                   f"page_size={args.page_size}, "
+                   f"prompts {args.prompt_len_min}-{args.prompt_len_max}, "
+                   f"outputs {args.new_tokens_min}-{args.new_tokens_max})"),
+        "value": round(total_new / wall, 2),
+        "unit": "tokens/s",
+        "n_requests": len(results),
+        "total_new_tokens": total_new,
+        "wall_s": round(wall, 3),
+        "ttft_ms_p50": round(_pct(ttfts, 0.50), 2),
+        "ttft_ms_p99": round(_pct(ttfts, 0.99), 2),
+        "tbot_ms_p50": round(_pct(tbots, 0.50), 2),
+        "tbot_ms_p99": round(_pct(tbots, 0.99), 2),
+        "decode_steps": stats["decode_steps"],
+        "peak_page_pool_utilization": stats["peak_page_pool_utilization"],
+        "recompiles_steady_state": int(recompiles),
+        "serve_counters": {k: v for k, v in counters.items() if k.startswith("serve.")},
+    }
+    print(json.dumps(row, indent=1))
+    if os.environ.get("BENCH_SERVE") == "1":
+        with open(args.artifact, "w") as f:
+            json.dump(row, f, indent=1)
+        print(f"wrote {args.artifact}")
+    return row
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_name", default="tiny-llama2")
+    p.add_argument("--streams", type=int, default=8)
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--page_size", type=int, default=16)
+    p.add_argument("--max_seq", type=int, default=256)
+    p.add_argument("--prompt_len_min", type=int, default=8)
+    p.add_argument("--prompt_len_max", type=int, default=48)
+    p.add_argument("--new_tokens_min", type=int, default=8)
+    p.add_argument("--new_tokens_max", type=int, default=32)
+    p.add_argument("--arrival_rate", type=float, default=8.0,
+                   help="open-loop arrivals per second")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--artifact", default="BENCH_SERVE.json")
+    run(p.parse_args())
+
+
+if __name__ == "__main__":
+    main()

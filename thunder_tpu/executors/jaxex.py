@@ -201,8 +201,14 @@ for pid, fn in _binary_impls.items():
 
 
 def _div_torch(a, b):
-    # torch true_divide on ints promotes to float; prim contract says clang
-    # already promoted, so plain divide is correct here
+    # torch true_divide on ints promotes to float, and clang.true_divide
+    # pre-promotes (int_to_float=True) — so float operands take the plain
+    # divide. Int operands reach DIV only via clang.floor_divide, whose
+    # meta keeps the integer dtype: execute integer (floor) division so the
+    # runtime dtype matches the trace (true_divide here returned f32 and
+    # broke downstream integer consumers, e.g. gather indices).
+    if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+        return jnp.floor_divide(a, b)
     return jnp.true_divide(a, b)
 
 

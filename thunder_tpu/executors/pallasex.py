@@ -1519,3 +1519,160 @@ def _nf4_kl_impl(x, packed_kl, absmax_kl, out_features, in_features,
 
 ex.register_implementation("quant.linear_nf4_kl", _nf4_kl_impl,
                            checker=_nf4_kl_supported)
+
+
+# ===========================================================================
+# Paged attention — decode (serving engine, thunder_tpu/serving/)
+# ===========================================================================
+#
+# Continuous-batching decode attends ONE new token per sequence against a
+# block-paged KV pool (vLLM/PagedAttention, SOSP '23): k/v live in a fixed
+# (n_pages, page_size, Hkv, D) pool per layer and each sequence owns a row
+# of page ids. The kernel gathers a sequence's pages via the page table
+# INSIDE the pallas grid — the table rides as a scalar-prefetch operand so
+# the k/v BlockSpec index maps resolve page ids before each DMA — and runs
+# the flash kernel's online-softmax body (base-2 exp, f32 accumulation)
+# across the page axis in VMEM scratch. The ltorch.paged_attention
+# decomposition (ops/ltorch.py) is the pure-jax gather reference path for
+# CPU/interpret mode and for shapes the kernel declines.
+
+# decode working set is small (one page pair + one q group per program), but
+# absurd page_size x D configs must fall back, not fail-to-compile: estimate
+# VMEM like _cap_blocks_for_dtype and decline the claim over the budget
+# (ADVICE r5: estimate + automatic fallback instead of an env escape hatch)
+_PAGED_VMEM_LIMIT = int(os.environ.get("TT_PAGED_VMEM_LIMIT", str(14 * 2**20)))
+
+
+def _paged_vmem_bytes(page_size: int, D: int, g: int, kv_itemsize: int, q_itemsize: int) -> int:
+    """Estimated per-program VMEM working set: double-buffered k/v page
+    blocks, the q group block, and the f32 accumulator/output tiles."""
+    kv = 2 * (2 * page_size * D * kv_itemsize)  # k + v, double-buffered DMA
+    qb = g * D * q_itemsize
+    acc = g * D * 4 + 2 * g * 4  # f32 acc + m/l scratch
+    out = g * D * q_itemsize
+    return kv + qb + acc + out
+
+
+def _paged_attn_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_scr, m_scr, l_scr, *, page_size: int, scale: float):
+    # grid (B, Hkv, n_pages_max) with pages innermost: scratch carries the
+    # online softmax across one sequence's pages; o is written ONCE at the
+    # last page. q_ref: (g, D) — the kv head's q group; k_ref/v_ref:
+    # (page_size, D) — the page the table mapped this grid step to.
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+    g, D = q_ref.shape
+    seq_len = sl_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # pages entirely past the sequence are skipped: their table entries
+    # point at the reserved null page, so the DMA is in-bounds but the
+    # values are garbage — never let them into the accumulators
+    @pl.when(p * page_size < seq_len)
+    def _compute():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * (scale * LOG2E)
+        # partially-filled last page: mask slots at/after seq_len
+        k_pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (g, page_size), 1)
+        s = jnp.where(k_pos < seq_len, s, NEG_INF)
+        m_prev = m_scr[:][:, 0]
+        l_prev = l_scr[:][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        pexp = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m_prev - m_new)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new[:, None]
+        l_scr[:] = (l_prev * corr + jnp.sum(pexp, axis=1))[:, None]
+
+    @pl.when(p == n_p - 1)
+    def _write():
+        l = l_scr[:][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens, scale=None,
+                           *, interpret: bool | None = None):
+    """q (B, H, D) against a paged pool (P, page_size, Hkv, D) through
+    page_table (B, n_pages_max) int32 / seq_lens (B,) int32 -> (B, H, D).
+
+    seq_lens counts valid tokens INCLUDING the current one (whose k/v must
+    already be written to its page). interpret=True runs the kernel in
+    pallas interpret mode (the CPU equivalence tests)."""
+    B, H, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    npm = page_table.shape[1]
+    g = H // Hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qg = q.reshape(B, Hkv, g, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, npm),
+        in_specs=[
+            pl.BlockSpec((None, None, g, D), lambda b, h, p, pt, sl: (b, h, 0, 0)),
+            pl.BlockSpec((None, ps, None, D), lambda b, h, p, pt, sl: (pt[b, p], 0, h, 0)),
+            pl.BlockSpec((None, ps, None, D), lambda b, h, p, pt, sl: (pt[b, p], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, g, D), lambda b, h, p, pt, sl: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((g, D), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=ps, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, D), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(B, H, D)
+
+
+def paged_attention_supported(q, k_pages, v_pages, page_table, seq_lens, scale=None) -> bool:
+    """Checker: the paged decode kernel claims thunder.paged_attention on
+    TPU (TT_PAGED_KERNEL=1 forces the claim for interpret-mode A/B, =0
+    never claims); shapes must fit the page tiling and the estimated VMEM
+    working set must stay under budget — otherwise the pure-jax gather
+    decomposition runs."""
+    if pltpu is None:
+        return False
+    override = os.environ.get("TT_PAGED_KERNEL")
+    if override == "0":
+        return False
+    if not (_on_tpu() or override == "1"):
+        return False
+    if getattr(q, "ndim", 0) != 3 or getattr(k_pages, "ndim", 0) != 4:
+        return False
+    B, H, D = q.shape
+    P, ps, Hkv, Dk = k_pages.shape
+    shapes_ok = (
+        D == Dk and D <= 512
+        and tuple(v_pages.shape) == tuple(k_pages.shape)
+        and H % Hkv == 0
+        and ps % 8 == 0  # sublane tile
+        and getattr(page_table, "ndim", 0) == 2 and page_table.shape[0] == B
+        and getattr(seq_lens, "ndim", 0) == 1 and seq_lens.shape[0] == B
+    )
+    if not shapes_ok:
+        return False
+    kv_item = jnp.dtype(str(k_pages.dtype).rpartition(".")[2]).itemsize
+    q_item = jnp.dtype(str(q.dtype).rpartition(".")[2]).itemsize
+    return _paged_vmem_bytes(ps, D, H // Hkv, kv_item, q_item) <= _PAGED_VMEM_LIMIT
+
+
+def _paged_attention_impl(q, k_pages, v_pages, page_table, seq_lens, scale=None):
+    return paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens, scale)
+
+
+ex.register_implementation("thunder.paged_attention", _paged_attention_impl,
+                           checker=paged_attention_supported)

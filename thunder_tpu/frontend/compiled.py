@@ -33,6 +33,63 @@ class InterpretedEntry:
         self.shape_key = shape_key
 
 
+class ShapeKeyedMRU:
+    """shape_key -> [entries], most-recently-hit first.
+
+    The cache discipline shared by the interpreter frontend's specialization
+    cache and the serving engine's bucketed prefill entries
+    (thunder_tpu/serving/scheduler.py): lookup is one dict probe plus a scan
+    of the bucket's snapshot, and the entry that served the call is promoted
+    to the front so the steady-state probe order stays one-deep.
+
+    Concurrency contract: bucket MUTATIONS (promotion, insertion) hold
+    ``lock``; the steady-state hit (front entry) never locks. Readers scan
+    an atomic ``snapshot`` (one C-level list copy under the GIL) and every
+    mutation is a single atomic list op — ``insert`` is one insert-at-front,
+    ``promote`` replaces the contents in ONE slice assignment — so a racing
+    promotion can never hide an entry from a scan (which would cost a
+    recompile and grow a duplicate specialization)."""
+
+    __slots__ = ("buckets", "lock")
+
+    def __init__(self):
+        self.buckets: dict = {}
+        self.lock = threading.Lock()
+
+    def snapshot(self, key) -> list:
+        """Atomic copy of the bucket for ``key`` (empty when absent); safe
+        to scan without holding ``lock``."""
+        bucket = self.buckets.get(key)
+        return list(bucket) if bucket is not None else []
+
+    def insert(self, key, entry) -> None:
+        """Register ``entry`` at the FRONT of its bucket: the newest
+        specialization probes first — its guards match the call that just
+        built it, which steady state repeats."""
+        with self.lock:
+            self.buckets.setdefault(key, []).insert(0, entry)
+
+    def promote(self, key, entry) -> None:
+        """Move ``entry`` to the front of its bucket. The slice assignment
+        replaces the contents in ONE atomic operation — unlocked snapshots
+        never see the entry mid-flight (a remove+insert pair would have a
+        window where the entry is in neither position)."""
+        with self.lock:
+            bucket = self.buckets.get(key)
+            if bucket is not None:
+                bucket[:] = [entry] + [e for e in bucket if e is not entry]
+
+    def clear(self) -> None:
+        with self.lock:
+            self.buckets.clear()
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    def __contains__(self, key) -> bool:
+        return key in self.buckets
+
+
 class InterpretedFunction:
     """jit-compiled via the bytecode interpreter frontend."""
 
@@ -60,15 +117,12 @@ class InterpretedFunction:
         self._entries: list[InterpretedEntry] = []
         # shape_key -> [entries], most-recently-hit first: cache lookup is
         # one dict probe + (usually) one prologue run instead of a linear
-        # scan over every specialization ever compiled. Bucket MUTATIONS
-        # (MRU promotion, registration) hold _mru_lock so concurrent callers
-        # can't corrupt a bucket; the steady-state hit (front entry) never
-        # locks. Readers scan an atomic list(bucket) snapshot and mutations
-        # are single atomic list ops, so a racing promotion can never hide
-        # an entry from a scan (which would cost a recompile and grow a
-        # duplicate specialization).
-        self._entries_by_key: dict = {}
-        self._mru_lock = threading.Lock()
+        # scan over every specialization ever compiled (concurrency
+        # contract documented on ShapeKeyedMRU). _entries_by_key/_mru_lock
+        # alias the MRU internals so existing introspection keeps working.
+        self._mru = ShapeKeyedMRU()
+        self._entries_by_key: dict = self._mru.buckets
+        self._mru_lock = self._mru.lock
         # (treedef, leaf types) -> (mask, tensor_idx, number_idx): repeat
         # calls skip per-leaf _is_tensor_like re-masking. Keyed on the leaf
         # TYPES too because a treedef alone does not determine tensor-ness
@@ -177,10 +231,7 @@ class InterpretedFunction:
         cs.last_traces = traces
         cs.last_prologue_traces = [pro]
         self._entries.append(entry)
-        # newest specialization probes first: its guards match the call that
-        # just compiled it, which steady state repeats
-        with self._mru_lock:
-            self._entries_by_key.setdefault(shape_key, []).insert(0, entry)
+        self._mru.insert(shape_key, entry)
         return entry
 
     def __call__(self, *args, **kwargs):
@@ -217,37 +268,25 @@ class InterpretedFunction:
         if self.cache_option == "no caching":
             entry = self._compile(args, kwargs, shape_key)
             self._entries.clear()
-            with self._mru_lock:
-                self._entries_by_key.clear()
+            self._mru.clear()
             # this mode retains NOTHING between calls; keeping leaf plans
             # would grow without bound under varying argument structures
             self._leaf_plans.clear()
             return entry.computation_fn(*entry.prologue_fn(*tensor_leaves))
-        # a cache hit is the first prologue that runs without raising
+        # a cache hit is the first prologue that runs without raising; the
+        # scan runs over an atomic snapshot and the serving entry is
+        # promoted to the bucket front (ShapeKeyedMRU's contract)
         guard_failed = False
-        bucket = self._entries_by_key.get(shape_key)
-        if bucket is not None:
-            # scan an atomic snapshot: list(bucket) is one C-level copy under
-            # the GIL, and every bucket mutation (slice-assign promotion
-            # below, insert-at-front registration) keeps the live list
-            # complete at each instant — a racing promotion can therefore
-            # never hide an entry from this scan and force a spurious
-            # recompile, and the hit path stays lock-free
-            for i, entry in enumerate(list(bucket)):
+        bucket = self._mru.snapshot(shape_key)
+        if bucket:
+            for i, entry in enumerate(bucket):
                 try:
                     flat_inputs = entry.prologue_fn(*tensor_leaves)
                 except Exception:
                     guard_failed = True
                     continue
                 if i:
-                    # MRU: the entry whose guards pass moves to the front so
-                    # the steady-state probe order stays one-deep. The
-                    # slice assignment replaces the contents in ONE atomic
-                    # operation — unlocked snapshots never see the entry
-                    # mid-flight (a remove+insert pair would have a window
-                    # where the entry is in neither position)
-                    with self._mru_lock:
-                        bucket[:] = [entry] + [e for e in bucket if e is not entry]
+                    self._mru.promote(shape_key, entry)
                 cs.cache_hits += 1
                 if obs_on:
                     _obs_metrics.record_cache("trace", "hit", fn=self.__name__)

@@ -79,6 +79,46 @@ def cached_sdpa(q, k_cache, v_cache, pos, scale=None):
     return ltorch.matmul(probs, v_cache)
 
 
+def split_qkv_rope(block, cfg, x_n, cos, sin):
+    """Project + split + rope one block's q/k/v for T tokens: the per-block
+    attention-input plumbing shared by the dense decode engine below and the
+    paged serving runner (serving/runner.py) — ONE implementation, so block
+    math can never drift between solo and continuously-batched decoding
+    (the serving tests pin exact token equality between the two).
+    Returns q (B, nh, T, hs), k/v (B, ng, T, hs)."""
+    from .models.litgpt import _apply_rope
+
+    B, T, _ = x_n.shape
+    nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
+    q_per_kv = nh // ng
+    qkv = block.attn.attn(x_n)
+    qkv = ltorch.reshape(qkv, (B, T, ng, q_per_kv + 2, hs))
+    q = ltorch.reshape(qkv[:, :, :, :q_per_kv, :], (B, T, nh, hs))
+    k = ltorch.reshape(qkv[:, :, :, q_per_kv: q_per_kv + 1, :], (B, T, ng, hs))
+    v = ltorch.reshape(qkv[:, :, :, q_per_kv + 1:, :], (B, T, ng, hs))
+    q = ltorch.permute(q, (0, 2, 1, 3))
+    k = ltorch.permute(k, (0, 2, 1, 3))
+    v = ltorch.permute(v, (0, 2, 1, 3))
+    q = _apply_rope(q, cos, sin, cfg.rope_n_elem)
+    k = _apply_rope(k, cos, sin, cfg.rope_n_elem)
+    return q, k, v
+
+
+def block_mix(block, cfg, x, h):
+    """Residual + MLP/MoE tail of one block (the other half of the shared
+    plumbing; see split_qkv_rope)."""
+    mlp = getattr(block, "mlp", None)
+    is_moe = mlp is None
+    if is_moe:
+        mlp = block.moe  # MoE decoder blocks (models/moe.py MoEBlock)
+    if cfg.parallel_residual and not is_moe:
+        # MoEBlock.forward is always sequential (moe.py:92-93); only
+        # litgpt Blocks honor parallel_residual
+        return x + h + mlp(block.norm_2(x))
+    x = x + h
+    return x + mlp(block.norm_2(x))
+
+
 class GPTInference:
     """Greedy/temperature generation over a models.litgpt.GPT or
     models.moe.MoEGPT (Mixtral-style MoE decoder).
@@ -115,26 +155,13 @@ class GPTInference:
         sin = prims.dynamic_slice(sin_full, (pos, 0), (T, n_elem))
         x = gpt.wte(idx)
         new_ks, new_vs = [], []
+        nh, ng = cfg.n_head, cfg.n_query_groups
+        q_per_kv = nh // ng
         for li, block in enumerate(gpt.h):
-            x_n = block.norm_1(x)
-            att = block.attn
-            nh, ng, hs = cfg.n_head, cfg.n_query_groups, cfg.head_size
-            qkv = att.attn(x_n)
-            q_per_kv = nh // ng
-            qkv = ltorch.reshape(qkv, (B, T, ng, q_per_kv + 2, hs))
-            q = ltorch.reshape(qkv[:, :, :, :q_per_kv, :], (B, T, nh, hs))
-            k = ltorch.reshape(qkv[:, :, :, q_per_kv: q_per_kv + 1, :], (B, T, ng, hs))
-            v = ltorch.reshape(qkv[:, :, :, q_per_kv + 1:, :], (B, T, ng, hs))
-            q = ltorch.permute(q, (0, 2, 1, 3))
-            k = ltorch.permute(k, (0, 2, 1, 3))
-            v = ltorch.permute(v, (0, 2, 1, 3))
-            from .models.litgpt import _apply_rope, _repeat_kv
+            from .models.litgpt import _repeat_kv
 
-            q = _apply_rope(q, cos, sin, cfg.rope_n_elem)
-            k = _apply_rope(k, cos, sin, cfg.rope_n_elem)
+            q, k, v = split_qkv_rope(block, cfg, block.norm_1(x), cos, sin)
             # insert into cache at pos
-            from .core import prims
-
             k_cache = prims.dynamic_update_slice(ks[li], k, (0, 0, pos, 0))
             v_cache = prims.dynamic_update_slice(vs[li], v, (0, 0, pos, 0))
             new_ks.append(k_cache)
@@ -142,19 +169,8 @@ class GPTInference:
             kq = _repeat_kv(k_cache, q_per_kv) if ng != nh else k_cache
             vq = _repeat_kv(v_cache, q_per_kv) if ng != nh else v_cache
             y = cached_sdpa(q, kq, vq, pos)
-            y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * hs))
-            h = att.proj(y)
-            mlp = getattr(block, "mlp", None)
-            is_moe = mlp is None
-            if is_moe:
-                mlp = block.moe  # MoE decoder blocks (models/moe.py MoEBlock)
-            if cfg.parallel_residual and not is_moe:
-                # MoEBlock.forward is always sequential (moe.py:92-93); only
-                # litgpt Blocks honor parallel_residual
-                x = x + h + mlp(block.norm_2(x))
-            else:
-                x = x + h
-                x = x + mlp(block.norm_2(x))
+            y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)), (B, T, nh * cfg.head_size))
+            x = block_mix(block, cfg, x, block.attn.proj(y))
         x = gpt.ln_f(x)
         logits = gpt.lm_head(x[:, -1])  # only last position needed for generation
         return logits, tuple(new_ks), tuple(new_vs)
@@ -208,15 +224,33 @@ class GPTInference:
     _scan_sig = None
 
     def generate(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
-                 collect_metrics: bool = False, scan_decode: bool = True):
+                 seed: Optional[int] = None, collect_metrics: bool = False,
+                 scan_decode: bool = True):
         """prompt: (B, T) int array. Returns (tokens (B, T+max_new), metrics).
 
         scan_decode=True (greedy only): all decode steps compile into one XLA
-        program — one dispatch for the whole generation."""
+        program — one dispatch for the whole generation.
+
+        seed keys temperature sampling: the token at position p draws from
+        fold_in(PRNGKey(seed), p), so two generations with the same seed are
+        identical and the stream matches the serving engine's
+        (serving/scheduler.py) for the same request seed."""
         cfg = self.cfg
         B, T = prompt.shape
+        if T + max_new_tokens > self.max_seq:
+            # an overlong generation would let dynamic_update_slice clamp its
+            # writes at the cache edge, silently corrupting the KV tail —
+            # refuse up front instead
+            raise ValueError(
+                f"prompt_len={T} + max_new_tokens={max_new_tokens} exceeds "
+                f"max_seq={self.max_seq}; build the engine with a larger "
+                f"max_seq (or shorten the generation)")
         if self._decode_cfn is None:
             self._build(B, T)
+        # seeds are canonicalized mod 2^32 so the stream matches the serving
+        # engine's (whose packed seed array is uint32) for any Python int
+        sample_key = jax.random.PRNGKey(
+            (seed if seed is not None else 0) & 0xFFFFFFFF)
         # raw arrays: Parameter wrappers don't abstract under the jitted scan
         params = {k: p.data for k, p in self.gpt.named_parameters()}
         cache = KVCache(cfg.n_layer, B, cfg.n_query_groups, self.max_seq, cfg.head_size, self.dtype)
@@ -228,7 +262,12 @@ class GPTInference:
         t_start = time.perf_counter()
         with _obs_runtime.step_span("infer_prefill", B=B, T=T) if obs_on else _NULL:
             logits, ks, vs = self._prefill_cfn(params, prompt, ks, vs)
-            next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
+            if temperature > 0.0:
+                next_tok = jax.random.categorical(
+                    jax.random.fold_in(sample_key, T),
+                    logits / temperature, -1).astype(prompt.dtype)
+            else:
+                next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)
             jax.block_until_ready(next_tok)
         ttft = time.perf_counter() - t_start
         if obs_on:
@@ -273,7 +312,10 @@ class GPTInference:
                 logits, ks, vs = self._decode_cfn(params, next_tok[:, None], ks, vs,
                                                   jnp.asarray(pos, jnp.int32))
                 if temperature > 0.0:
-                    key = jax.random.PRNGKey(pos)
+                    # position-keyed split of the per-request key: the OLD
+                    # PRNGKey(pos) drew the SAME stream for every generation
+                    # at the same position, whatever the request
+                    key = jax.random.fold_in(sample_key, pos + 1)
                     next_tok = jax.random.categorical(key, logits / temperature, -1).astype(prompt.dtype)
                 else:
                     next_tok = jnp.argmax(logits, -1).astype(prompt.dtype)

@@ -149,6 +149,20 @@ def record_intervention(reason: str, **attrs) -> None:
     events.event("guard", reason=reason, **attrs)
 
 
+def record_serve(outcome: str, delta: int = 1, event: bool = False, **attrs) -> None:
+    """Serving-engine traffic: bumps ``serve.<outcome>`` and, for the
+    low-rate lifecycle outcomes (admission/retirement), records a
+    ``serve_<outcome>`` timeline event carrying the request tags
+    (request id, ttft_ms/tbot_ms, pool_utilization). High-rate outcomes
+    (decode_steps, tokens) stay counter-only so a long-running engine
+    doesn't flood the ring buffer."""
+    if not events.enabled():
+        return
+    events.inc(f"serve.{outcome}", delta)
+    if event:
+        events.event(f"serve_{outcome}", **attrs)
+
+
 def record_fusion(executor: str, n_regions: int, n_ops: int, **attrs) -> None:
     """Fusion-pass outcome for one executor over one trace."""
     if not events.enabled():

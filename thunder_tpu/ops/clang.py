@@ -781,7 +781,28 @@ def index_put(a, indices, values, accumulate=False):
         full_idx = expand(reshape(idx, tuple(idx_shape)), tuple(bshape))
         src = values if tuple(values.shape) == tuple(bshape) else expand(values, tuple(bshape))
         return scatter_add(a, full_idx, src, 0)
-    raise NotImplementedError("index_put with multiple index tensors")
+    if len(indices) > 1 and all(getattr(i, "ndim", None) == 1 for i in indices):
+        # multiple 1-D index vectors over the LEADING dims (the paged-KV
+        # write pattern: pool[page_ids, slots] = token_kv): linearize to one
+        # flat index over the collapsed leading dims and recurse into the
+        # single-index path. Same-length vectors index jointly, numpy-style.
+        # Each vector is canonicalized with remainder (Python-modulo
+        # semantics) so numpy-style negative indices land in THEIR dim
+        # before linearization — a raw -1 in dim d would otherwise address
+        # the previous row's last slot.
+        n = len(indices)
+        check(a.ndim >= n,
+              lambda: f"index_put: {n} index tensors over a rank-{a.ndim} input")
+        flat = remainder(indices[0], a.shape[0])
+        for d in range(1, n):
+            flat = flat * a.shape[d] + remainder(indices[d], a.shape[d])
+        lead = 1
+        for d in range(n):
+            lead *= a.shape[d]
+        a_flat = reshape(a, (lead,) + tuple(a.shape[n:]))
+        out = index_put(a_flat, (flat,), values, accumulate)
+        return reshape(out, tuple(a.shape))
+    raise NotImplementedError("index_put with multiple >1-D index tensors")
 
 
 def diagonal(a, offset=0, dim1=0, dim2=1):

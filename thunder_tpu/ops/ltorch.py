@@ -905,6 +905,47 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, en
     return prims.matmul(probs, v)
 
 
+@torchsymbol(name="paged_attention", id="thunder.paged_attention")
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None):
+    """Decode-step attention of ONE new token per sequence against a
+    block-paged KV pool (vLLM/PagedAttention, SOSP '23).
+
+    q            (B, H, D)           — the current token's query heads
+    k_pages/v_pages (P, page_size, Hkv, D) — the shared per-layer page pool
+    page_table   (B, n_pages_max) int — per-sequence page ids; entries beyond
+                 the sequence's pages point at the reserved null page 0
+    seq_lens     (B,) int            — valid tokens per sequence INCLUDING
+                 the current one (whose k/v is already written to its page)
+
+    The decomposition below is the pure-jax gather reference path (CPU /
+    interpret mode / unclaimed shapes); the pallas executor claims the
+    symbol whole with a scalar-prefetch paged decode kernel on TPU
+    (executors/pallasex.py:paged_attention_decode)."""
+    B, H, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    npm = page_table.shape[1]
+    T = npm * ps
+    check(H % Hkv == 0,
+          lambda: f"paged_attention: q heads {H} not divisible by kv heads {Hkv}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    flat = reshape(page_table, (B * npm,))
+    k = clang.take(k_pages, flat, 0)  # (B*npm, ps, Hkv, D)
+    v = clang.take(v_pages, flat, 0)
+    k = permute(reshape(k, (B, T, Hkv, D)), (0, 2, 1, 3))  # (B, Hkv, T, D)
+    v = permute(reshape(v, (B, T, Hkv, D)), (0, 2, 1, 3))
+    if H != Hkv:
+        k = repeat_interleave(k, H // Hkv, 1)
+        v = repeat_interleave(v, H // Hkv, 1)
+    qe = reshape(q, (B, H, 1, D))
+    scores = clang.mul(prims.matmul(qe, clang.matrix_transpose(k)), scale)  # (B, H, 1, T)
+    k_pos = reshape(prims.iota(T, dtype=dtypes.int32, device=q.device), (1, 1, 1, T))
+    live = clang.lt(k_pos, reshape(seq_lens, (B, 1, 1, 1)))
+    scores = clang.where(live, scores, float("-inf"))
+    probs = softmax(scores, -1)
+    probs = clang.maybe_convert_to_dtype(probs, v.dtype)
+    return reshape(prims.matmul(probs, v), (B, H, D))
+
+
 @torchsymbol(name="cross_entropy", id="torch.nn.functional.cross_entropy")
 def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
     """Composite cross-entropy over class dim 1 / last for 2D (logits (N, C)).

@@ -1,0 +1,23 @@
+"""thunder_tpu.serving — continuous-batching inference engine.
+
+The production counterpart of the single-stream `inference.GPTInference`
+(ROADMAP open item #2): a fixed page pool of KV memory shared by all
+in-flight sequences (kv_pages.py), paged decode/prefill programs traced
+through the thunder jit (runner.py), and a continuous-batching scheduler
+that admits, decodes, and retires requests every iteration (scheduler.py).
+
+    from thunder_tpu.serving import ServingEngine
+    engine = ServingEngine(gpt, max_batch=8, page_size=16, max_seq=256)
+    fut = engine.submit(prompt_ids, max_new_tokens=32)
+    result = fut.result()      # result.tokens, result.ttft_s, result.tbot_s
+"""
+from .kv_pages import OutOfPages, PageAllocator, PagedKVCache
+from .scheduler import RequestResult, ServingEngine
+
+__all__ = [
+    "OutOfPages",
+    "PageAllocator",
+    "PagedKVCache",
+    "RequestResult",
+    "ServingEngine",
+]

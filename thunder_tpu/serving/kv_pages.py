@@ -1,0 +1,120 @@
+"""Block-paged KV cache: a fixed page pool shared by all in-flight sequences.
+
+The vLLM/PagedAttention (SOSP '23) memory design mapped onto the static-shape
+XLA world: each layer owns one `(n_pages, page_size, n_kv_heads, head_dim)`
+device array and every sequence owns an int32 row of page ids into it. The
+pool shape never changes, so ONE compiled decode step serves every mix of
+sequence lengths; allocation is pure host bookkeeping over a free-list, and
+a finished request's pages return to the pool immediately at retirement.
+
+Page 0 is reserved as the NULL page: unallocated page-table entries and idle
+decode slots point at it, keeping every gather/DMA in-bounds (the attention
+masks its values out via seq_lens; see executors/pallasex.py).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+NULL_PAGE = 0
+
+
+class OutOfPages(Exception):
+    """The pool cannot satisfy an allocation; the scheduler queues the
+    request until retirements return pages."""
+
+
+class PageAllocator:
+    """Free-list allocator over page ids [1, n_pages); page 0 is the
+    reserved null page and is never handed out."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f"need at least 2 pages (1 usable + null), got {n_pages}")
+        self.n_pages = n_pages
+        # LIFO free-list: recently-freed pages are re-used first (their pool
+        # slices are most likely still warm in cache hierarchies that care).
+        # The mirror set makes free()'s double-free check O(1) — retirement
+        # runs inside the decode iteration loop, so freeing k pages must not
+        # scan a production-sized free list k times.
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"requested {n} pages, {len(self._free)} free "
+                             f"of {self.n_pages - 1} usable")
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        seen = set()
+        for p in pages:
+            if not (0 < p < self.n_pages):
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free_set or p in seen:
+                # a duplicate WITHIN the call is a double free too: letting
+                # it through would hand the same page to two sequences later
+                raise ValueError(f"double free of page {p}")
+            seen.add(p)
+        self._free.extend(pages)
+        self._free_set.update(pages)
+
+    def utilization(self) -> float:
+        usable = self.n_pages - 1
+        return self.n_used / usable if usable else 0.0
+
+
+class PagedKVCache:
+    """Per-layer paged K/V pools plus the allocator that parcels them out.
+
+    The device arrays are FUNCTIONAL state: the decode/prefill programs
+    return updated pools and the scheduler re-binds `k_pages`/`v_pages`
+    each step (same discipline as the dense engine's KVCache tuples).
+    """
+
+    def __init__(self, n_layer: int, n_pages: int, page_size: int,
+                 n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+        shape = (n_pages, page_size, n_kv_heads, head_dim)
+        self.n_layer = n_layer
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.k_pages = tuple(jnp.zeros(shape, dtype) for _ in range(n_layer))
+        self.v_pages = tuple(jnp.zeros(shape, dtype) for _ in range(n_layer))
+        self.allocator = PageAllocator(n_pages)
+
+    @staticmethod
+    def pages_for(n_tokens: int, page_size: int) -> int:
+        return max(1, math.ceil(n_tokens / page_size))
+
+    def rebind(self, k_pages, v_pages) -> None:
+        """Adopt the updated pools returned by a compiled step."""
+        self.k_pages = tuple(k_pages)
+        self.v_pages = tuple(v_pages)
+
+    def utilization(self) -> float:
+        return self.allocator.utilization()
+
+    def page_table_row(self, pages: List[int], n_pages_max: int) -> np.ndarray:
+        """A sequence's page-table row, padded with the null page."""
+        row = np.full((n_pages_max,), NULL_PAGE, np.int32)
+        row[: len(pages)] = np.asarray(pages, np.int32)
+        return row

@@ -1,0 +1,146 @@
+"""Paged model programs: bucketed prefill and the packed decode step.
+
+The serving analog of `inference.GPTInference._forward_cached`: the GPT
+module structure is reused and the blocks run manually under the thunder
+jit, but the KV state is the shared page pool (kv_pages.py) instead of a
+per-request dense cache.
+
+Two compiled programs:
+
+* prefill — per prompt-length BUCKET (power-of-two): dense causal attention
+  over the padded prompt, page write-out of the prompt's K/V, logits at the
+  true last token. One thunder specialization per bucket; the scheduler's
+  ShapeKeyedMRU keeps steady-state lookups one probe deep.
+* decode — ONE program for the whole engine: every active sequence
+  contributes one token; k/v land in the pool at (page_table[pos//ps],
+  pos%ps) via a batched index_put and attention runs over the pages
+  (ltorch.paged_attention — pallas kernel on TPU, jax gather on CPU).
+
+Both are pure functional: pools go in, updated pools come out.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..inference import block_mix, cached_sdpa, split_qkv_rope
+from ..ops import clang, ltorch
+
+
+def bucket_len(n: int, *, minimum: int, maximum: int) -> int:
+    """Next power-of-two >= n, floored at `minimum` (>= page_size so every
+    bucket is page-aligned) and capped at `maximum` (= max_seq)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, maximum)
+
+
+class PagedGPTRunner:
+    """Traces and caches the paged prefill/decode programs for one GPT."""
+
+    def __init__(self, gpt, *, page_size: int):
+        from .. import jit as _jit
+        from ..nn.module import functional_params
+
+        self.gpt = gpt
+        self.cfg = gpt.cfg
+        self.page_size = page_size
+
+        def prefill(params, idx, page_ids, kps, vps, last_pos):
+            with functional_params(gpt, params):
+                return self._forward_prefill(idx, page_ids, kps, vps, last_pos)
+
+        def decode(params, toks, kps, vps, page_table, pos):
+            with functional_params(gpt, params):
+                return self._forward_decode(toks, kps, vps, page_table, pos)
+
+        prefill.__name__ = "serve_prefill"
+        decode.__name__ = "serve_decode"
+        self.prefill_cfn = _jit(prefill)
+        self.decode_cfn = _jit(decode)
+
+    # block plumbing (qkv split/rope, residual/MoE tail) is shared with the
+    # dense engine: inference.split_qkv_rope / inference.block_mix — one
+    # implementation, so solo and batched decode can never drift
+
+    # -- prefill ----------------------------------------------------------
+    def _forward_prefill(self, idx, page_ids, kps, vps, last_pos):
+        """idx (1, Lb) bucketed prompt; page_ids (Lb/page_size,) pages to
+        write; last_pos scalar int32 — the true last token. Returns
+        (logits (1, V), new k pools, new v pools). Padding tokens beyond
+        last_pos write garbage K/V into the tail pages — causality keeps
+        them out of every real token's attention and seq_lens masks them
+        out of later paged decode."""
+        from ..core import prims
+        from ..models.litgpt import _repeat_kv
+
+        cfg = self.cfg
+        gpt = self.gpt
+        B, T = idx.shape
+        ps = self.page_size
+        n_elem = cfg.rope_n_elem
+        cos = clang.ensure_proxy(gpt.cos)[:T]
+        sin = clang.ensure_proxy(gpt.sin)[:T]
+        q_per_kv = cfg.n_head // cfg.n_query_groups
+        x = gpt.wte(idx)
+        new_kps, new_vps = [], []
+        for li, block in enumerate(gpt.h):
+            q, k, v = split_qkv_rope(block, cfg, block.norm_1(x), cos, sin)
+            # page write-out: (1, Hkv, T, hs) -> (T//ps, ps, Hkv, hs) blocks
+            k_blocks = ltorch.reshape(ltorch.permute(k, (0, 2, 1, 3)),
+                                      (T // ps, ps, cfg.n_query_groups, cfg.head_size))
+            v_blocks = ltorch.reshape(ltorch.permute(v, (0, 2, 1, 3)),
+                                      (T // ps, ps, cfg.n_query_groups, cfg.head_size))
+            new_kps.append(ltorch.index_put(kps[li], (page_ids,), k_blocks))
+            new_vps.append(ltorch.index_put(vps[li], (page_ids,), v_blocks))
+            kq = _repeat_kv(k, q_per_kv) if cfg.n_query_groups != cfg.n_head else k
+            vq = _repeat_kv(v, q_per_kv) if cfg.n_query_groups != cfg.n_head else v
+            y = cached_sdpa(q, kq, vq, 0)
+            y = ltorch.reshape(ltorch.permute(y, (0, 2, 1, 3)),
+                               (B, T, cfg.n_head * cfg.head_size))
+            x = block_mix(block, cfg, x, block.attn.proj(y))
+        # logits at the TRUE last token (the bucket pads past it)
+        x_last = prims.dynamic_slice(x, (0, last_pos, 0), (B, 1, cfg.n_embd))
+        logits = gpt.lm_head(gpt.ln_f(x_last))[:, 0]
+        return logits, tuple(new_kps), tuple(new_vps)
+
+    # -- decode -----------------------------------------------------------
+    def _forward_decode(self, toks, kps, vps, page_table, pos):
+        """toks (Bcap, 1) current tokens; page_table (Bcap, n_pages_max)
+        int32; pos (Bcap,) int32 — each sequence's write position (= tokens
+        already cached; idle slots carry pos 0 and a null-page row).
+        Returns (logits (Bcap, V), new k pools, new v pools)."""
+        cfg = self.cfg
+        gpt = self.gpt
+        B, T = toks.shape  # T == 1
+        ps = self.page_size
+        # per-sequence rope rows: gather cos/sin at each slot's position
+        cos = ltorch.reshape(clang.take(clang.ensure_proxy(gpt.cos), pos, 0),
+                             (B, 1, 1, cfg.rope_n_elem))
+        sin = ltorch.reshape(clang.take(clang.ensure_proxy(gpt.sin), pos, 0),
+                             (B, 1, 1, cfg.rope_n_elem))
+        page_of = ltorch.gather(page_table, 1, ltorch.reshape(
+            ltorch.floor_divide(pos, ps), (B, 1)))[:, 0]  # (B,) page id
+        slot = ltorch.remainder(pos, ps)
+        seq_lens = pos + 1  # attention covers the token being written
+        x = gpt.wte(toks)
+        new_kps, new_vps = [], []
+        for li, block in enumerate(gpt.h):
+            q, k, v = split_qkv_rope(block, cfg, block.norm_1(x), cos, sin)
+            k_tok = ltorch.reshape(ltorch.permute(k, (0, 2, 1, 3)),
+                                   (B, cfg.n_query_groups, cfg.head_size))
+            v_tok = ltorch.reshape(ltorch.permute(v, (0, 2, 1, 3)),
+                                   (B, cfg.n_query_groups, cfg.head_size))
+            kp = ltorch.index_put(kps[li], (page_of, slot), k_tok)
+            vp = ltorch.index_put(vps[li], (page_of, slot), v_tok)
+            new_kps.append(kp)
+            new_vps.append(vp)
+            q3 = ltorch.reshape(q, (B, cfg.n_head, cfg.head_size))
+            y = ltorch.paged_attention(q3, kp, vp, page_table, seq_lens)
+            y = ltorch.reshape(y, (B, 1, cfg.n_head * cfg.head_size))
+            x = block_mix(block, cfg, x, block.attn.proj(y))
+        logits = gpt.lm_head(gpt.ln_f(x[:, -1]))
+        return logits, tuple(new_kps), tuple(new_vps)

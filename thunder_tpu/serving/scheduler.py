@@ -1,0 +1,541 @@
+"""Continuous-batching scheduler: admit, prefill, decode, retire — every step.
+
+The Orca (OSDI '22) iteration-level scheduling loop over the paged KV pool:
+
+* submit() enqueues a request and returns a concurrent.futures.Future.
+* Each engine iteration ADMITS pending requests into free decode slots
+  (FIFO; a request is admitted only when the page pool can cover its whole
+  lifetime — prompt pages plus worst-case growth — so decode can never hit
+  a mid-flight out-of-pages), runs one shape-BUCKETED prefill per admission
+  (prompt padded to the next power-of-two, so the thunder trace cache serves
+  every prompt length from a handful of specializations; per-bucket entries
+  ride a ShapeKeyedMRU — the same cache discipline as the interpreter
+  frontend), then packs ALL active sequences into ONE compiled decode step
+  over the page pool and retires finished sequences, returning their pages
+  to the free-list immediately.
+
+Per-request observability rides the existing bus: request-id-tagged spans,
+``serve.*`` counters, and flight-recorder records per decode iteration
+(docs/serving.md, docs/observability.md).
+
+Sampling is position-keyed — token at position p draws from
+``fold_in(PRNGKey(seed), p)`` — so a request's stream is identical whether
+it runs solo (inference.GPTInference.generate) or continuously batched.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frontend.compiled import ShapeKeyedMRU
+from ..observability import events as _obs
+from ..observability import flight_recorder as _obs_flight
+from ..observability import metrics as _obs_metrics
+from ..observability import runtime as _obs_runtime
+from .kv_pages import PagedKVCache
+from .runner import PagedGPTRunner, bucket_len
+
+_NULL = contextlib.nullcontext()
+
+
+@dataclass
+class RequestResult:
+    """What a request's Future resolves to."""
+
+    request_id: int
+    tokens: np.ndarray          # prompt + generated, (prompt_len + n_new,)
+    new_tokens: np.ndarray      # generated only, (n_new,)
+    ttft_s: float               # submit -> first token
+    tbot_s: float               # mean time between output tokens
+    n_new_tokens: int = 0
+    finish_reason: str = "length"   # "length" | "eos"
+
+
+@dataclass
+class _Request:
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    eos_id: Optional[int]
+    future: Future
+    t_submit: float
+    t_first: float = 0.0
+    t_last: float = 0.0
+    tokens: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    bucket: int = 0
+
+
+@dataclass
+class _BucketEntry:
+    """Per-bucket serving entry tracked by the ShapeKeyedMRU: the bucket's
+    static shapes plus traffic stats (the compiled specializations
+    themselves live in the thunder trace cache, keyed by these shapes)."""
+
+    bucket: int
+    n_prompt_pages: int
+    hits: int = 0
+
+
+def _sample_tokens(logits, seeds, pos, temps):
+    """Position-keyed sampling: token at position p for request seed s draws
+    from fold_in(PRNGKey(s), p). temps == 0 -> greedy argmax."""
+
+    def one(l, s, p, t):
+        key = jax.random.fold_in(jax.random.PRNGKey(s), p)
+        safe_t = jnp.where(t > 0, t, 1.0)
+        sampled = jax.random.categorical(key, l / safe_t)
+        return jnp.where(t > 0, sampled, jnp.argmax(l, -1))
+
+    return jax.vmap(one)(logits, seeds, pos, temps).astype(jnp.int32)
+
+
+class ServingEngine:
+    """Continuous-batching inference over a models.litgpt.GPT (or MoEGPT).
+
+    max_batch   decode slots (sequences packed into one decode step)
+    page_size   tokens per KV page
+    n_pages     pool size per layer (default: full residency for max_batch
+                sequences of max_seq tokens, plus the reserved null page)
+    max_seq     per-sequence length cap (prompt + generated)
+    """
+
+    def __init__(self, gpt, *, max_batch: int = 8, page_size: int = 16,
+                 n_pages: Optional[int] = None, max_seq: Optional[int] = None,
+                 dtype=jnp.bfloat16, min_bucket: Optional[int] = None):
+        cfg = gpt.cfg
+        self.gpt = gpt
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.page_size = page_size
+        self.max_seq = max_seq or cfg.block_size
+        rope_rows = gpt.cos.shape[0]
+        if self.max_seq > rope_rows:
+            raise ValueError(
+                f"max_seq={self.max_seq} exceeds the model's rope cache "
+                f"({rope_rows} positions); build the GPT with a larger block_size")
+        if self.max_seq % page_size:
+            raise ValueError(f"max_seq={self.max_seq} must be a multiple of "
+                             f"page_size={page_size}")
+        self.n_pages_max = self.max_seq // page_size  # page-table width
+        if n_pages is None:
+            n_pages = 1 + max_batch * self.n_pages_max
+        self.min_bucket = max(page_size, min_bucket or page_size)
+        if self.min_bucket % page_size:
+            # buckets double from min_bucket, so page alignment of every
+            # bucket reduces to alignment of the first — reject a
+            # misconfiguration here instead of surfacing it as an opaque
+            # reshape error inside every prefill trace
+            raise ValueError(f"min_bucket={self.min_bucket} must be a "
+                             f"multiple of page_size={page_size}")
+        self.dtype = dtype
+
+        self.cache = PagedKVCache(cfg.n_layer, n_pages, page_size,
+                                  cfg.n_query_groups, cfg.head_size, dtype)
+        self.runner = PagedGPTRunner(gpt, page_size=page_size)
+        self.params = {k: p.data for k, p in gpt.named_parameters()}
+        self._sampler = jax.jit(_sample_tokens)
+
+        # bucketed-prefill entries under ONE ordered bucket ("buckets"),
+        # most-recently-served first — the probe-order discipline the
+        # interpreter frontend applies to a bucket of specializations
+        # (ShapeKeyedMRU, reused); _bucket_index gives O(1) lookup so a
+        # steady-state admission never scans the order list to find its entry
+        self.prefill_buckets = ShapeKeyedMRU()
+        self._bucket_index: dict = {}
+
+        # host-side packed decode state; pos/toks change every step and are
+        # re-uploaded, while seeds/temps/page tables only change at
+        # (un)assignment — their device copies are cached under _pt_dirty
+        self._page_tables = np.zeros((max_batch, self.n_pages_max), np.int32)
+        self._pos = np.zeros((max_batch,), np.int32)
+        self._toks = np.zeros((max_batch,), np.int32)
+        self._seeds = np.zeros((max_batch,), np.uint32)
+        self._temps = np.zeros((max_batch,), np.float32)
+        self._pt_dev = None
+        self._seeds_dev = None
+        self._temps_dev = None
+        self._pt_dirty = True
+        self._slots: List[Optional[_Request]] = [None] * max_batch
+
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        # submitted-but-unresolved count: _has_work()/drain() key off this
+        # rather than scanning pending+slots, which is momentarily EMPTY
+        # between a pop from the queue and the slot assignment (a drain
+        # racing the loop thread would return mid-prefill otherwise)
+        self._outstanding = 0
+        self._stopped = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.decode_steps = 0
+        self.peak_pages_in_use = 0
+
+    # -- public API -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
+               seed: Optional[int] = None, eos_id: Optional[int] = None) -> Future:
+        """Enqueue one generation request; thread-safe. The Future resolves
+        to a RequestResult (or a ValueError for an inadmissible request)."""
+        fut: Future = Future()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        L = int(prompt.shape[0])
+        worst = self._pages_needed(L, max_new_tokens)
+        usable = self.cache.n_pages - 1
+        if L < 1 or L + max_new_tokens > self.max_seq or max_new_tokens < 1:
+            fut.set_exception(ValueError(
+                f"request {rid}: prompt_len={L} + max_new_tokens={max_new_tokens} "
+                f"must fit max_seq={self.max_seq} (and both be >= 1)"))
+            return fut
+        if worst > usable:
+            fut.set_exception(ValueError(
+                f"request {rid}: needs {worst} pages, pool has {usable}"))
+            return fut
+        # seeds canonicalized mod 2^32 (the packed sampler array is uint32);
+        # inference.generate applies the same mask, keeping the documented
+        # solo-vs-batched stream equivalence for any Python int seed
+        req = _Request(rid, prompt, max_new_tokens, float(temperature),
+                       int(seed if seed is not None else rid) & 0xFFFFFFFF,
+                       eos_id, fut, time.perf_counter())
+        with self._lock:
+            if self._stopped:
+                # stop() already flushed the queue; a late submit must fail
+                # loudly rather than enqueue a Future nothing will resolve
+                fut.set_exception(RuntimeError("serving engine stopped"))
+                return fut
+            self._pending.append(req)
+            self._outstanding += 1
+        if _obs.enabled():
+            _obs_metrics.record_serve("requests")
+        return fut
+
+    def start(self) -> None:
+        """Run the scheduling loop on a background thread."""
+        if self._thread is not None:
+            return
+        with self._lock:
+            self._stopped = False
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="tt-serving",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = False) -> None:
+        """Stop the loop thread. drain=True finishes outstanding requests
+        first; otherwise every in-flight and pending Future is FAILED (with
+        pages returned) — a stopped engine must never leave a waiter
+        hanging on a Future that nothing will ever resolve."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            self._stop.clear()
+            self.drain()
+            self._stop.set()
+        exc = RuntimeError("serving engine stopped")
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._fail(req, exc)
+                self._clear_slot(i)
+        with self._lock:
+            # flag + flush under ONE lock section: a racing submit either
+            # lands before the flush (failed here) or sees _stopped and
+            # fails itself — no window leaves an unresolvable Future
+            self._stopped = True
+            pending, self._pending = list(self._pending), deque()
+        for req in pending:
+            self._fail(req, exc)
+
+    def drain(self) -> None:
+        """Block until every submitted request resolved. With the
+        background thread running this only WAITS (stepping inline too
+        would race the thread over slots and pool state); without it, the
+        loop runs inline (deterministic test/benchmark driver)."""
+        if self._thread is not None:
+            while self._has_work():
+                time.sleep(1e-3)
+            return
+        while self._has_work():
+            self._step_once()
+
+    def warmup(self, prompt_lens, max_new_tokens: int = 2) -> None:
+        """Pre-compile the decode step and the prefill bucket for each
+        prompt length (steady state then never recompiles)."""
+        for L in prompt_lens:
+            self.submit(np.zeros((L,), np.int32), max_new_tokens)
+        self.drain()
+
+    def stats(self) -> dict:
+        usable = self.cache.n_pages - 1
+        return {
+            "pages_in_use": self.cache.allocator.n_used,
+            "page_pool_utilization": round(self.cache.utilization(), 4),
+            "peak_page_pool_utilization": round(self.peak_pages_in_use / usable, 4)
+            if usable else 0.0,
+            "active": sum(1 for s in self._slots if s is not None),
+            "pending": len(self._pending),
+            "decode_steps": self.decode_steps,
+            "prefill_buckets": [e.bucket for e in
+                                self.prefill_buckets.snapshot("buckets")],
+        }
+
+    # -- scheduling loop --------------------------------------------------
+    def _has_work(self) -> bool:
+        return self._outstanding > 0
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._has_work():
+                time.sleep(1e-3)
+                continue
+            try:
+                self._step_once()
+            except Exception as e:  # pragma: no cover - scheduler-bug net
+                # per-request failures are contained in _prefill/_decode
+                # (futures failed, pages freed); anything reaching here is a
+                # scheduler bug — keep the thread alive for other requests
+                # rather than silently hanging every future forever
+                import warnings
+
+                warnings.warn(f"serving loop error (contained): {e!r}")
+                time.sleep(1e-2)
+
+    def _pages_needed(self, L: int, max_new: int) -> int:
+        """Worst-case pages over the request lifetime: the bucketed prefill
+        writes bucket//page_size pages, growth extends to L+max_new tokens.
+        Reserving the max at admission means decode can never hit a
+        mid-flight out-of-pages (the admission policy; docs/serving.md)."""
+        bucket = bucket_len(L, minimum=self.min_bucket, maximum=self.max_seq)
+        return max(bucket // self.page_size,
+                   PagedKVCache.pages_for(L + max_new, self.page_size))
+
+    def _step_once(self) -> None:
+        self._admit()
+        self._decode()
+
+    def _admit(self) -> None:
+        while True:
+            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            if not free_slots:
+                return
+            with self._lock:
+                if not self._pending:
+                    return
+                req = self._pending[0]
+                if req.future.cancelled():
+                    # cancelled while queued: drop before allocating anything
+                    self._pending.popleft()
+                    self._outstanding -= 1
+                    continue
+                need = self._pages_needed(len(req.prompt), req.max_new_tokens)
+                if not self.cache.allocator.can_alloc(need):
+                    return  # FIFO head-of-line: wait for retirements
+                self._pending.popleft()
+            req.pages = self.cache.allocator.alloc(need)
+            self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                         self.cache.allocator.n_used)
+            self._prefill(req, free_slots[0])
+
+    def _fail(self, req: _Request, exc: Exception) -> None:
+        """Contain one request's failure: return its pages, fail its Future
+        (waiters see the error instead of hanging), keep the engine alive."""
+        if req.pages:
+            self.cache.allocator.free(req.pages)
+            req.pages = []
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass  # caller's cancel() raced the done() window — already dead
+        with self._lock:
+            self._outstanding -= 1
+        if _obs.enabled():
+            _obs_metrics.record_serve("failed", event=True,
+                                      request=req.request_id,
+                                      error=type(exc).__name__)
+
+    def _prefill(self, req: _Request, slot: int) -> None:
+        obs_on = _obs.enabled()
+        L = len(req.prompt)
+        bucket = bucket_len(L, minimum=self.min_bucket, maximum=self.max_seq)
+        req.bucket = bucket
+        n_prompt_pages = bucket // self.page_size
+        self._touch_bucket(bucket, n_prompt_pages)
+        idx = np.zeros((1, bucket), np.int32)
+        idx[0, :L] = req.prompt
+        page_ids = jnp.asarray(req.pages[:n_prompt_pages], jnp.int32)
+        t0 = time.perf_counter()
+        try:
+            with (_obs_runtime.step_span("serve_prefill", request=req.request_id,
+                                         bucket=bucket, prompt_len=L)
+                  if obs_on else _NULL):
+                logits, kps, vps = self.runner.prefill_cfn(
+                    self.params, jnp.asarray(idx), page_ids,
+                    self.cache.k_pages, self.cache.v_pages,
+                    jnp.asarray(L - 1, jnp.int32))
+                self.cache.rebind(kps, vps)
+                tok0 = self._sampler(logits,
+                                     jnp.asarray([req.seed], jnp.uint32),
+                                     jnp.asarray([L], jnp.int32),
+                                     jnp.asarray([req.temperature], jnp.float32))
+                tok0 = int(np.asarray(tok0)[0])
+        except Exception as e:
+            self._fail(req, e)
+            return
+        req.t_first = req.t_last = time.perf_counter()
+        req.tokens.append(tok0)
+        if obs_on:
+            _obs_metrics.record_serve("prefills", event=True,
+                                      request=req.request_id, bucket=bucket,
+                                      prompt_len=L, ms=round((req.t_first - t0) * 1e3, 3),
+                                      pool_utilization=round(self.cache.utilization(), 4))
+            _obs_metrics.record_serve("prefill_tokens", delta=L)
+        if self._finished(req, tok0):
+            self._retire(req)
+            return
+        self._slots[slot] = req
+        self._page_tables[slot] = self.cache.page_table_row(req.pages, self.n_pages_max)
+        self._pos[slot] = L
+        self._toks[slot] = tok0
+        self._seeds[slot] = req.seed
+        self._temps[slot] = req.temperature
+        self._pt_dirty = True
+
+    def _touch_bucket(self, bucket: int, n_prompt_pages: int) -> None:
+        """ShapeKeyedMRU bookkeeping: the bucket just served moves to the
+        front of the probe order (mirrors the interpreter frontend's
+        steady-state discipline; stats() exposes the MRU order). The side
+        index makes the entry lookup O(1) — no order-list scan per
+        admission."""
+        entry = self._bucket_index.get(bucket)
+        if entry is not None:
+            entry.hits += 1
+            self.prefill_buckets.promote("buckets", entry)
+            return
+        entry = _BucketEntry(bucket, n_prompt_pages, hits=1)
+        self._bucket_index[bucket] = entry
+        self.prefill_buckets.insert("buckets", entry)
+
+    def _clear_slot(self, i: int) -> None:
+        self._slots[i] = None
+        self._page_tables[i] = 0
+        self._pos[i] = 0
+        self._toks[i] = 0
+        self._seeds[i] = 0
+        self._temps[i] = 0.0
+        self._pt_dirty = True
+
+    def _decode(self) -> None:
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter()
+        if self._pt_dirty:
+            # page tables / seeds / temps only change at slot (un)assignment;
+            # re-upload them then, not per token (pos/toks change every step)
+            self._pt_dev = jnp.asarray(self._page_tables)
+            self._seeds_dev = jnp.asarray(self._seeds)
+            self._temps_dev = jnp.asarray(self._temps)
+            self._pt_dirty = False
+        try:
+            with (_obs_runtime.step_span("serve_decode", active=len(active))
+                  if obs_on else _NULL):
+                logits, kps, vps = self.runner.decode_cfn(
+                    self.params, jnp.asarray(self._toks[:, None]),
+                    self.cache.k_pages, self.cache.v_pages,
+                    self._pt_dev, jnp.asarray(self._pos))
+                self.cache.rebind(kps, vps)
+                # the NEXT token's position is pos+1 (this step wrote pos)
+                nxt = self._sampler(logits, self._seeds_dev,
+                                    jnp.asarray(self._pos + 1),
+                                    self._temps_dev)
+                nxt = np.asarray(nxt)
+        except Exception as e:
+            # the packed step failed: every active sequence is implicated —
+            # fail their futures and return their pages rather than hanging
+            # the whole engine (pending requests still get admitted)
+            for i in active:
+                self._fail(self._slots[i], e)
+                self._clear_slot(i)
+            return
+        t_now = time.perf_counter()
+        self.decode_steps += 1
+        if obs_on:
+            _obs_metrics.record_serve("decode_steps")
+            _obs_metrics.record_serve("tokens", delta=len(active))
+            _obs_flight.record_step((t_now - t0) * 1e3, fn="serve_decode",
+                                    active=len(active))
+        for i in active:
+            req = self._slots[i]
+            tok = int(nxt[i])
+            req.tokens.append(tok)
+            req.t_last = t_now
+            self._pos[i] += 1
+            self._toks[i] = tok
+            if self._finished(req, tok):
+                self._retire(req)
+                self._clear_slot(i)
+
+    def _finished(self, req: _Request, tok: int) -> bool:
+        if req.future.cancelled():
+            # the caller gave up: stop decoding and free the pages now
+            return True
+        if req.eos_id is not None and tok == req.eos_id:
+            return True
+        return len(req.tokens) >= req.max_new_tokens
+
+    def _retire(self, req: _Request) -> None:
+        self.cache.allocator.free(req.pages)
+        req.pages = []
+        n_new = len(req.tokens)
+        ttft = req.t_first - req.t_submit
+        tbot = ((req.t_last - req.t_first) / (n_new - 1)) if n_new > 1 else 0.0
+        if req.future.cancelled():
+            # a client-side cancel is not a completion: tag it so latency
+            # percentiles (obs_summary) aren't polluted by truncated samples
+            reason = "cancelled"
+        elif (req.eos_id is not None and req.tokens
+              and req.tokens[-1] == req.eos_id):
+            reason = "eos"
+        else:
+            reason = "length"
+        if _obs.enabled():
+            _obs_metrics.record_serve(
+                "cancelled" if reason == "cancelled" else "retired",
+                event=True, request=req.request_id, n_new=n_new,
+                ttft_ms=round(ttft * 1e3, 3), tbot_ms=round(tbot * 1e3, 3),
+                finish=reason,
+                pool_utilization=round(self.cache.utilization(), 4))
+        result = RequestResult(
+            request_id=req.request_id,
+            tokens=np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)]),
+            new_tokens=np.asarray(req.tokens, np.int32),
+            ttft_s=ttft,
+            tbot_s=tbot,
+            n_new_tokens=n_new,
+            finish_reason=reason,
+        )
+        try:
+            # a cancel() from the caller thread can land at ANY point, so a
+            # done() pre-check would still race — set and swallow the loss
+            # (pages are already freed above either way)
+            req.future.set_result(result)
+        except InvalidStateError:
+            pass
+        with self._lock:
+            self._outstanding -= 1

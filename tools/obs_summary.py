@@ -198,6 +198,49 @@ def spike_lines(recs: list[dict]) -> list[str]:
     return lines
 
 
+def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """Serving-engine section: serve.* traffic counters plus TTFT/TBOT
+    percentiles from serve_retired events and prefill/decode span latency
+    (thunder_tpu/serving/; docs/serving.md)."""
+    serve_counters = {k: v for k, v in counters.items() if k.startswith("serve.")}
+    retires = [r.get("attrs", {}) for r in recs
+               if r.get("kind") == "event" and r.get("name") == "serve_retired"]
+    if not serve_counters and not retires:
+        return []
+    lines = []
+    for k, v in sorted(serve_counters.items()):
+        lines.append(f"  {k.removeprefix('serve.'):<24} {v}")
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+    ttfts = sorted(a["ttft_ms"] for a in retires if "ttft_ms" in a)
+    # one-token requests have NO between-token interval (the engine records
+    # a 0.0 placeholder) — exclude them from the tbot population by n_new,
+    # not by truthiness, so a real 0.0ms sample would still count
+    tbots = sorted(a["tbot_ms"] for a in retires
+                   if "tbot_ms" in a and a.get("n_new", 0) > 1)
+    if ttfts:
+        lines.append(f"  ttft_ms                  p50={pct(ttfts, 0.5):.2f}  "
+                     f"p99={pct(ttfts, 0.99):.2f}  max={ttfts[-1]:.2f}")
+    if tbots:
+        lines.append(f"  tbot_ms                  p50={pct(tbots, 0.5):.2f}  "
+                     f"p99={pct(tbots, 0.99):.2f}  max={tbots[-1]:.2f}")
+    utils = [a["pool_utilization"] for a in retires + [
+        r.get("attrs", {}) for r in recs
+        if r.get("kind") == "event" and r.get("name") == "serve_prefills"]
+        if "pool_utilization" in a]
+    if utils:
+        lines.append(f"  page_pool_utilization    peak={max(utils):.2%}")
+    for name in ("serve_prefill", "serve_decode"):
+        durs = sorted(r["dur_ms"] for r in recs
+                      if r.get("kind") == "span" and r.get("name") == name)
+        if durs:
+            lines.append(f"  {name:<24} n={len(durs)}  p50={pct(durs, 0.5):.2f}ms  "
+                         f"p95={pct(durs, 0.95):.2f}ms")
+    return lines
+
+
 def device_profiles(recs: list[dict]) -> list[dict]:
     return [r["attrs"]["profile"] for r in recs
             if r.get("kind") == "event" and r.get("name") == "device_profile"
@@ -273,8 +316,11 @@ def render(recs: list[dict], top: int = 0) -> str:
     host = host_overhead_stats(recs)
     if host:
         out += ["", "== host dispatch overhead ==", *host]
+    serving = serving_lines(recs, counters)
+    if serving:
+        out += ["", "== serving ==", *serving]
     other = {k: v for k, v in counters.items()
-             if not k.startswith("recompile.")
+             if not k.startswith("recompile.") and not k.startswith("serve.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]
