@@ -45,6 +45,11 @@ def pytest_configure(config):
         "serve: serving-engine test (continuous batching + paged KV cache; "
         "runs under JAX_PLATFORMS=cpu interpret mode in tier-1; filter with "
         "-m serve / -m 'not serve')")
+    config.addinivalue_line(
+        "markers",
+        "telemetry: live-telemetry test (streaming percentiles, metrics "
+        "exporter, SLO monitors, perf gate; filter with -m telemetry / "
+        "-m 'not telemetry')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -181,6 +181,97 @@ class TestCrashHook:
         assert sys.excepthook is hook
         fr.uninstall_crash_hook()
 
+    def test_repeated_install_never_self_chains(self):
+        """Repeated installs (engine/test setup per construction) must not
+        stack _crash_hook onto itself — calling the hook would recurse."""
+        orig = sys.excepthook
+        try:
+            for _ in range(5):
+                fr.install_crash_hook()
+            assert sys.excepthook is fr._crash_hook
+            assert fr._prev_excepthook is orig
+            fr.uninstall_crash_hook()
+            assert sys.excepthook is orig
+        finally:
+            fr._hook_installed = False
+            fr._prev_excepthook = None
+            sys.excepthook = orig
+
+    def test_reinstall_chains_to_foreign_hook(self):
+        """A foreign hook installed on top of ours since the last install
+        becomes the chain target on re-install — both hooks still run."""
+        orig = sys.excepthook
+        seen = []
+        try:
+            fr.install_crash_hook()
+            foreign = lambda *a: seen.append("foreign")  # noqa: E731
+            sys.excepthook = foreign
+            fr.install_crash_hook()  # must chain to `foreign`, not stale orig
+            assert sys.excepthook is fr._crash_hook
+            assert fr._prev_excepthook is foreign
+            fr._crash_hook(ValueError, ValueError("x"), None)
+            assert seen == ["foreign"]
+        finally:
+            fr._hook_installed = False
+            fr._prev_excepthook = None
+            sys.excepthook = orig
+
+    def test_chaining_foreign_hook_cycle_does_not_recurse(self, tmp_path,
+                                                          monkeypatch, capfd):
+        """A foreign hook that chains to the hook it replaced (sentry-style)
+        plus a re-install forms a cycle _crash_hook -> foreign ->
+        _crash_hook; the reentrancy guard must break it instead of
+        recursing until RecursionError garbles the crash report — AND still
+        render the traceback (in the cycle the original hook was dropped
+        from the chain, so nothing else would print it)."""
+        monkeypatch.setenv("TT_FLIGHT_FILE", str(tmp_path / "cycle.json"))
+        orig = sys.excepthook
+        calls = []
+        try:
+            fr.install_crash_hook()
+            saved = sys.excepthook  # == _crash_hook
+
+            def foreign(*a):
+                calls.append("foreign")
+                saved(*a)  # chains back to _crash_hook
+
+            sys.excepthook = foreign
+            fr.install_crash_hook()  # _prev is now `foreign` -> cycle
+            fr.recorder().record_step(1.0)
+            fr._crash_hook(ValueError, ValueError("boom-cycle"), None)
+            assert calls == ["foreign"]
+            assert (tmp_path / "cycle.json").exists()  # dumped exactly once
+            err = capfd.readouterr().err
+            assert "boom-cycle" in err  # the crash is never silent
+        finally:
+            fr.recorder().reset()
+            fr._hook_installed = False
+            fr._prev_excepthook = None
+            fr._in_crash_hook = False
+            sys.excepthook = orig
+
+    def test_uninstall_leaves_foreign_hook_installed(self, tmp_path, monkeypatch):
+        """If a foreign hook replaced sys.excepthook after our install,
+        uninstall must not clobber it — it only disarms the dump (a foreign
+        chained reference to _crash_hook keeps passing exceptions through)."""
+        monkeypatch.setenv("TT_FLIGHT_FILE", str(tmp_path / "no.json"))
+        orig = sys.excepthook
+        try:
+            fr.install_crash_hook()
+            foreign = lambda *a: None  # noqa: E731
+            sys.excepthook = foreign
+            fr.uninstall_crash_hook()
+            assert sys.excepthook is foreign
+            # disarmed: even with ring contents, _crash_hook won't dump
+            fr.recorder().record_step(1.0)
+            fr._crash_hook(ValueError, ValueError("x"), None)
+            assert not (tmp_path / "no.json").exists()
+        finally:
+            fr.recorder().reset()
+            fr._hook_installed = False
+            fr._prev_excepthook = None
+            sys.excepthook = orig
+
 
 class TestDisabledZeroWork:
     def test_disabled_step_path_never_touches_recorder(self, rng, monkeypatch):

@@ -1,18 +1,35 @@
-"""Serving load benchmark: open-loop streams against the continuous-batching
-engine (thunder_tpu/serving/), reporting aggregate tokens/sec, TTFT/TBOT
-p50/p99, page-pool utilization, and the steady-state recompile count.
+"""Serving load benchmark: open- or closed-loop streams against the
+continuous-batching engine (thunder_tpu/serving/), reporting aggregate
+tokens/sec, TTFT/TBOT p50/p99, page-pool utilization, the steady-state
+recompile count, and — with an SLO configured — goodput.
 
-The load generator is OPEN-LOOP (Orca/vLLM evaluation style): request
-arrival times are drawn up front from an exponential inter-arrival process
-and requests are submitted on that schedule whatever the engine's backlog —
-so queueing delay shows up in TTFT instead of being hidden by a closed loop.
-Prompt and output lengths are drawn uniformly from mixed ranges.
+Two load modes:
+
+* ``--mode open`` (default; Orca/vLLM evaluation style): request arrival
+  times are drawn up front from an exponential inter-arrival process and
+  requests are submitted on that schedule whatever the engine's backlog —
+  so queueing delay shows up in TTFT instead of being hidden by a closed
+  loop.
+* ``--mode closed``: ``--concurrency`` requests stay in flight; each
+  completion immediately submits the next until ``--streams`` total have
+  run. With ``--slo_ttft_ms``/``--slo_tbot_ms`` set, the engine stamps a
+  per-request SLO-met flag at retirement and the row reports **goodput**
+  (the fraction meeting the SLO) and **requests/s meeting the SLO** — the
+  ROADMAP #2 acceptance metric.
+
+Requests that produced <= 1 token have no between-token interval; they are
+excluded from the TBOT percentiles but still counted in aggregate tokens/s,
+so the row reports ``n_truncated`` explicitly to keep goodput and latency
+denominators honest.
 
 Usage:
     python -m thunder_tpu.benchmarks.benchmark_serving --model_name tiny-llama2 \
         --streams 8 --page_size 16 --arrival_rate 16
+    python -m thunder_tpu.benchmarks.benchmark_serving --mode closed \
+        --concurrency 4 --slo_ttft_ms 50 --slo_tbot_ms 15
     BENCH_SERVE=1 python -m thunder_tpu.benchmarks.benchmark_serving ...
         # additionally writes the BENCH_SERVE.json artifact row
+        # (gate fresh runs against it with tools/perf_gate.py)
 """
 from __future__ import annotations
 
@@ -20,36 +37,43 @@ import argparse
 import json
 import os
 import time
+from concurrent.futures import FIRST_COMPLETED, wait
 
 import jax.numpy as jnp
 import numpy as np
 
 
-def _pct(xs, q):
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+from thunder_tpu.observability.telemetry import percentile as _pct
+
+
+def _submit(engine, rng, cfg, L, n, temperature):
+    prompt = rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+    return engine.submit(prompt, max_new_tokens=n, temperature=temperature,
+                         seed=int(rng.randint(1 << 30)))
 
 
 def run(args) -> dict:
     from thunder_tpu import observability
     from thunder_tpu.models.litgpt import Config, GPT
+    from thunder_tpu.observability.slo import SLOPolicy
     from thunder_tpu.serving import ServingEngine
+
+    slo = None
+    if args.slo_ttft_ms or args.slo_tbot_ms:
+        slo = SLOPolicy(p99_ttft_ms=args.slo_ttft_ms or None,
+                        p99_tbot_ms=args.slo_tbot_ms or None,
+                        min_samples=min(8, max(2, args.streams // 4)))
 
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     cfg = Config.from_name(args.model_name, block_size=max(args.max_seq, 128))
     gpt = GPT(cfg, dtype=dtype)
     engine = ServingEngine(gpt, max_batch=args.max_batch, page_size=args.page_size,
-                           max_seq=args.max_seq, dtype=dtype)
+                           max_seq=args.max_seq, dtype=dtype, slo=slo)
 
     rng = np.random.RandomState(args.seed)
     lens = [(int(rng.randint(args.prompt_len_min, args.prompt_len_max + 1)),
              int(rng.randint(args.new_tokens_min, args.new_tokens_max + 1)))
             for _ in range(args.streams)]
-    # exponential inter-arrivals -> open-loop schedule (seconds from t0)
-    gaps = rng.exponential(1.0 / args.arrival_rate, size=args.streams)
-    arrivals = np.cumsum(gaps) - gaps[0]
 
     observability.enable()
     # warm every bucket the workload will touch plus the decode step, then
@@ -57,20 +81,43 @@ def run(args) -> dict:
     # steady-state failure
     engine.warmup(sorted({L for L, _ in lens}), max_new_tokens=2)
     observability.reset()
+    engine.reset_slo_accounting()  # warmup must not pollute goodput/windows
 
     engine.start()
     t0 = time.perf_counter()
     futs = []
     try:
-        for (L, n), at in zip(lens, arrivals):
-            dt = t0 + float(at) - time.perf_counter()
-            if dt > 0:
-                time.sleep(dt)
-            prompt = rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
-            futs.append(engine.submit(prompt, max_new_tokens=n,
-                                      temperature=args.temperature,
-                                      seed=int(rng.randint(1 << 30))))
-        results = [f.result(timeout=600) for f in futs]
+        if args.mode == "open":
+            # exponential inter-arrivals -> open-loop schedule (s from t0)
+            gaps = rng.exponential(1.0 / args.arrival_rate, size=args.streams)
+            arrivals = np.cumsum(gaps) - gaps[0]
+            for (L, n), at in zip(lens, arrivals):
+                dt = t0 + float(at) - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                futs.append(_submit(engine, rng, cfg, L, n, args.temperature))
+            results = [f.result(timeout=600) for f in futs]
+        else:
+            # closed loop: a fixed number of in-flight requests; every
+            # completion immediately feeds the next submission
+            todo = list(lens)
+            inflight = set()
+            while todo and len(inflight) < max(1, args.concurrency):
+                L, n = todo.pop(0)
+                inflight.add(_submit(engine, rng, cfg, L, n, args.temperature))
+            futs = list(inflight)
+            while inflight:
+                done, inflight = wait(inflight, timeout=600,
+                                      return_when=FIRST_COMPLETED)
+                if not done:
+                    raise TimeoutError("closed-loop benchmark stalled")
+                for _ in done:
+                    if todo:
+                        L, n = todo.pop(0)
+                        f = _submit(engine, rng, cfg, L, n, args.temperature)
+                        inflight.add(f)
+                        futs.append(f)
+            results = [f.result(timeout=600) for f in futs]
     finally:
         engine.stop()
     wall = time.perf_counter() - t0
@@ -83,20 +130,27 @@ def run(args) -> dict:
 
     total_new = sum(r.n_new_tokens for r in results)
     ttfts = [r.ttft_s * 1e3 for r in results]
+    # <= 1 generated token -> no between-token interval: excluded from the
+    # TBOT percentiles (but still in aggregate tokens/s); n_truncated below
+    # reports the exclusion explicitly
     tbots = [r.tbot_s * 1e3 for r in results if r.n_new_tokens > 1]
+    n_truncated = sum(1 for r in results if r.n_new_tokens <= 1)
     stats = engine.stats()
     row = {
         "platform": jax.devices()[0].platform,
         "metric": (f"{args.model_name} serving aggregate new tokens/sec "
-                   f"({args.streams} open-loop streams, max_batch={args.max_batch}, "
+                   f"({args.streams} {args.mode}-loop streams, max_batch={args.max_batch}, "
                    f"page_size={args.page_size}, "
                    f"prompts {args.prompt_len_min}-{args.prompt_len_max}, "
                    f"outputs {args.new_tokens_min}-{args.new_tokens_max})"),
         "value": round(total_new / wall, 2),
         "unit": "tokens/s",
+        "mode": args.mode,
         "n_requests": len(results),
+        "n_truncated": n_truncated,
         "total_new_tokens": total_new,
         "wall_s": round(wall, 3),
+        "requests_per_s": round(len(results) / wall, 2),
         "ttft_ms_p50": round(_pct(ttfts, 0.50), 2),
         "ttft_ms_p99": round(_pct(ttfts, 0.99), 2),
         "tbot_ms_p50": round(_pct(tbots, 0.50), 2),
@@ -106,6 +160,14 @@ def run(args) -> dict:
         "recompiles_steady_state": int(recompiles),
         "serve_counters": {k: v for k, v in counters.items() if k.startswith("serve.")},
     }
+    if slo is not None:
+        n_met = sum(1 for r in results if r.slo_met)
+        row["slo"] = {"ttft_ms": args.slo_ttft_ms or None,
+                      "tbot_ms": args.slo_tbot_ms or None}
+        row["goodput"] = round(n_met / len(results), 4) if results else None
+        row["requests_per_s_slo_met"] = round(n_met / wall, 2)
+        row["slo_breaches"] = {k: v for k, v in counters.items()
+                               if k.startswith("slo.breach.")}
     print(json.dumps(row, indent=1))
     if os.environ.get("BENCH_SERVE") == "1":
         with open(args.artifact, "w") as f:
@@ -117,7 +179,10 @@ def run(args) -> dict:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model_name", default="tiny-llama2")
+    p.add_argument("--mode", default="open", choices=["open", "closed"])
     p.add_argument("--streams", type=int, default=8)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop in-flight request target")
     p.add_argument("--max_batch", type=int, default=8)
     p.add_argument("--page_size", type=int, default=16)
     p.add_argument("--max_seq", type=int, default=256)
@@ -127,6 +192,10 @@ def main():
     p.add_argument("--new_tokens_max", type=int, default=32)
     p.add_argument("--arrival_rate", type=float, default=8.0,
                    help="open-loop arrivals per second")
+    p.add_argument("--slo_ttft_ms", type=float, default=0.0,
+                   help="per-request TTFT target; enables goodput reporting")
+    p.add_argument("--slo_tbot_ms", type=float, default=0.0,
+                   help="per-request TBOT target; enables goodput reporting")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--seed", type=int, default=0)

@@ -29,6 +29,7 @@ from . import nn
 from .observability import events as _obs
 from .observability import flight_recorder as _obs_flight
 from .observability import runtime as _obs_runtime
+from .observability import telemetry as _obs_tel
 from .ops import clang, ltorch
 
 
@@ -272,6 +273,7 @@ class GPTInference:
         ttft = time.perf_counter() - t_start
         if obs_on:
             _obs_flight.record_step(ttft * 1e3, fn="infer_prefill", B=B, T=T)
+            _obs_tel.observe("infer.ttft_ms", ttft * 1e3)
 
         n_steps = max_new_tokens - 1
         use_scan = scan_decode and temperature == 0.0 and n_steps > 0
@@ -296,6 +298,7 @@ class GPTInference:
                 # per-token wall time is the window divided by its length
                 _obs_flight.record_step(dt * 1e3, fn="infer_decode",
                                         n_tokens=n_steps, scan=True)
+                _obs_tel.observe("infer.tbot_ms", dt * 1e3 / max(1, n_steps))
             out = jnp.concatenate([prompt, next_tok[:, None], toks_scan.T.astype(prompt.dtype)], axis=1)
             metrics = GenerationMetrics(
                 ttft_s=ttft,
@@ -326,6 +329,7 @@ class GPTInference:
             if obs_on:
                 _obs_flight.record_step(dt * 1e3, fn="infer_decode",
                                         n_tokens=n_steps, scan=False)
+                _obs_tel.observe("infer.tbot_ms", dt * 1e3 / max(1, n_steps))
 
         out = jnp.concatenate([prompt] + [t[:, None] for t in toks], axis=1)
         metrics = GenerationMetrics(

@@ -12,6 +12,9 @@ Quick start:
     cfn = tt.jit(fn); cfn(x)
     tt.observability.summary()                 # aggregated spans/counters
     tt.observability.last_compile_report(cfn)  # last compile, phase by phase
+    tt.observability.snapshot()                # live counters/gauges + online
+                                               # p50/p90/p99 per series
+    tt.observability.start_exporter(9100)      # or TT_OBS_EXPORT=<port|path>
 """
 from __future__ import annotations
 
@@ -53,7 +56,24 @@ from .runtime import (  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import profiler  # noqa: F401
-from .flight_recorder import install_crash_hook  # noqa: F401
+from . import slo  # noqa: F401
+from . import telemetry  # noqa: F401
+from .flight_recorder import install_crash_hook, uninstall_crash_hook  # noqa: F401
+from .slo import SLOMonitor, SLOPolicy  # noqa: F401
+from .telemetry import (  # noqa: F401
+    MetricsExporter,
+    StreamingHistogram,
+    gauge,
+    gauges,
+    histogram,
+    histogram_snapshots,
+    observe,
+    render_prometheus,
+    set_gauge,
+    snapshot,
+    start_exporter,
+    stop_exporter,
+)
 from .profiler import (  # noqa: F401
     DeviceProfile,
     attribute,

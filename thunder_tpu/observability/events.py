@@ -110,10 +110,15 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Clear recorded state (tests; keeps enabled/export settings)."""
+    """Clear recorded state (tests; keeps enabled/export settings). Also
+    clears the live-telemetry registry (histograms/gauges) so one reset
+    clears everything recorded."""
     with _BUS.lock:
         _BUS.records.clear()
         _BUS.counters.clear()
+    from . import telemetry  # deferred: telemetry imports this module
+
+    telemetry.reset()
 
 
 def records() -> list[dict]:
@@ -218,7 +223,12 @@ def counters() -> dict[str, int]:
 
 def summary() -> dict:
     """Aggregate view of everything recorded so far: per-span-name call
-    counts and total durations, counters, and reason-coded recompiles."""
+    counts and total durations, counters, reason-coded recompiles, plus the
+    live-telemetry view — serving traffic (``serve.*`` counters), gauges,
+    and streaming-histogram snapshots — so ONE call reports training and
+    serving state together (the online analog of tools/obs_summary.py)."""
+    from . import telemetry  # deferred: telemetry imports this module
+
     spans: dict[str, dict] = {}
     events_by_name: dict[str, int] = {}
     recompiles: list[dict] = []
@@ -232,11 +242,15 @@ def summary() -> dict:
             events_by_name[rec["name"]] = events_by_name.get(rec["name"], 0) + 1
             if rec["name"] == "recompile":
                 recompiles.append(rec)
+    snap = counters()
     return {
         "spans": spans,
         "events": events_by_name,
-        "counters": counters(),
+        "counters": snap,
         "recompiles": recompiles,
+        "serving": {k: v for k, v in snap.items() if k.startswith("serve.")},
+        "gauges": telemetry.gauges(),
+        "histograms": telemetry.histogram_snapshots(),
     }
 
 

@@ -203,21 +203,39 @@ def reset() -> None:
 
 _prev_excepthook = None
 _hook_installed = False
+_in_crash_hook = False
 
 
 def _crash_hook(exc_type, exc, tb):
+    global _in_crash_hook
+    if _in_crash_hook:
+        # a foreign hook that itself chains (sentry-style) can form a cycle
+        # with a re-install: _crash_hook -> foreign -> _crash_hook. Break it
+        # here rather than recurse until RecursionError garbles the report —
+        # and render the traceback ourselves, because in the cycle the
+        # original hook was dropped from the chain and nothing else will.
+        sys.__excepthook__(exc_type, exc, tb)
+        return
+    _in_crash_hook = True
     try:
-        if _RECORDER.records():
-            path = os.environ.get(
-                "TT_FLIGHT_FILE",
-                os.path.join(tempfile_dir(), f"tt_flight_{os.getpid()}.json"))
-            _RECORDER.dump(path)
-            print(f"# thunder_tpu flight recorder: {len(_RECORDER.records())} "
-                  f"steps dumped to {path}", file=sys.stderr)
-    except Exception:
-        pass
-    if _prev_excepthook is not None:
-        _prev_excepthook(exc_type, exc, tb)
+        try:
+            # _hook_installed gates the dump, not just install bookkeeping: a
+            # foreign hook may keep a chained reference to _crash_hook alive
+            # after uninstall_crash_hook(), and a disarmed hook must then only
+            # pass the exception through
+            if _hook_installed and _RECORDER.records():
+                path = os.environ.get(
+                    "TT_FLIGHT_FILE",
+                    os.path.join(tempfile_dir(), f"tt_flight_{os.getpid()}.json"))
+                _RECORDER.dump(path)
+                print(f"# thunder_tpu flight recorder: {len(_RECORDER.records())} "
+                      f"steps dumped to {path}", file=sys.stderr)
+        except Exception:
+            pass
+        if _prev_excepthook is not None:
+            _prev_excepthook(exc_type, exc, tb)
+    finally:
+        _in_crash_hook = False
 
 
 def tempfile_dir() -> str:
@@ -229,9 +247,17 @@ def tempfile_dir() -> str:
 def install_crash_hook() -> None:
     """Chain onto sys.excepthook: an uncaught exception dumps the ring to
     ``TT_FLIGHT_FILE`` (default: <tmp>/tt_flight_<pid>.json) so post-mortem
-    triage has the step-time history that led to the crash. Idempotent."""
+    triage has the step-time history that led to the crash.
+
+    Idempotent against REPEATED installs (engine/test setup may call this
+    per construction) and safe against interleaving with foreign hooks:
+    if sys.excepthook is already ``_crash_hook`` nothing changes (no
+    self-chain, which would recurse), and if another library replaced the
+    hook after a previous install, re-installing chains to THAT hook so
+    both still run — never to the stale pointer."""
     global _prev_excepthook, _hook_installed
-    if _hook_installed:
+    if sys.excepthook is _crash_hook:
+        _hook_installed = True
         return
     _prev_excepthook = sys.excepthook
     sys.excepthook = _crash_hook
@@ -239,9 +265,15 @@ def install_crash_hook() -> None:
 
 
 def uninstall_crash_hook() -> None:
+    """Undo install_crash_hook. If a foreign hook was installed on top of
+    ours since, it is left in place (restoring our saved pointer would
+    silently drop it) — ``_prev_excepthook`` is kept so the foreign hook's
+    chained calls into ``_crash_hook`` still reach the original hook; only
+    the dump behavior is disarmed via ``_hook_installed``."""
     global _prev_excepthook, _hook_installed
     if not _hook_installed:
         return
-    sys.excepthook = _prev_excepthook or sys.__excepthook__
-    _prev_excepthook = None
+    if sys.excepthook is _crash_hook:
+        sys.excepthook = _prev_excepthook or sys.__excepthook__
+        _prev_excepthook = None
     _hook_installed = False

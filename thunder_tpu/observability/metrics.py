@@ -149,6 +149,16 @@ def record_intervention(reason: str, **attrs) -> None:
     events.event("guard", reason=reason, **attrs)
 
 
+def record_slo_breach(reason: str, **attrs) -> None:
+    """An SLO target crossed into violation (slo.py; reason in
+    slo.BREACH_CODES). Counter ``slo.breach.<reason>`` + one reason-coded
+    ``slo.breach`` timeline event carrying value/target/burn_rate."""
+    if not events.enabled():
+        return
+    events.inc(f"slo.breach.{reason}")
+    events.event("slo.breach", reason=reason, **attrs)
+
+
 def record_serve(outcome: str, delta: int = 1, event: bool = False, **attrs) -> None:
     """Serving-engine traffic: bumps ``serve.<outcome>`` and, for the
     low-rate lifecycle outcomes (admission/retirement), records a

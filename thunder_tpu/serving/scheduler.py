@@ -41,6 +41,8 @@ from ..observability import events as _obs
 from ..observability import flight_recorder as _obs_flight
 from ..observability import metrics as _obs_metrics
 from ..observability import runtime as _obs_runtime
+from ..observability import telemetry as _obs_tel
+from ..observability.slo import SLOMonitor, SLOPolicy
 from .kv_pages import PagedKVCache
 from .runner import PagedGPTRunner, bucket_len
 
@@ -57,7 +59,10 @@ class RequestResult:
     ttft_s: float               # submit -> first token
     tbot_s: float               # mean time between output tokens
     n_new_tokens: int = 0
-    finish_reason: str = "length"   # "length" | "eos"
+    finish_reason: str = "length"   # "length" | "eos" | "cancelled"
+    # per-request SLO-met flag stamped at retirement when the engine has an
+    # SLOPolicy attached (the goodput numerator); None without a policy
+    slo_met: Optional[bool] = None
 
 
 @dataclass
@@ -113,7 +118,8 @@ class ServingEngine:
 
     def __init__(self, gpt, *, max_batch: int = 8, page_size: int = 16,
                  n_pages: Optional[int] = None, max_seq: Optional[int] = None,
-                 dtype=jnp.bfloat16, min_bucket: Optional[int] = None):
+                 dtype=jnp.bfloat16, min_bucket: Optional[int] = None,
+                 slo: Optional[SLOPolicy] = None):
         cfg = gpt.cfg
         self.gpt = gpt
         self.cfg = cfg
@@ -182,6 +188,16 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self.decode_steps = 0
         self.peak_pages_in_use = 0
+
+        # SLO measurement substrate (observability/slo.py): a declarative
+        # policy gets a sliding-window monitor (breach events/counters) and
+        # per-request SLO-met accounting at retirement — the goodput gauge
+        # ROADMAP #2's admission lanes will schedule against. Without a
+        # policy the retirement path pays one `is None` test.
+        self.slo_policy = slo
+        self.slo_monitor = SLOMonitor(slo, source="serving") if slo is not None else None
+        self.requests_retired = 0       # non-cancelled retirements
+        self.requests_slo_met = 0
 
     # -- public API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
@@ -282,7 +298,7 @@ class ServingEngine:
 
     def stats(self) -> dict:
         usable = self.cache.n_pages - 1
-        return {
+        out = {
             "pages_in_use": self.cache.allocator.n_used,
             "page_pool_utilization": round(self.cache.utilization(), 4),
             "peak_page_pool_utilization": round(self.peak_pages_in_use / usable, 4)
@@ -293,6 +309,33 @@ class ServingEngine:
             "prefill_buckets": [e.bucket for e in
                                 self.prefill_buckets.snapshot("buckets")],
         }
+        if self.slo_policy is not None:
+            out["requests_retired"] = self.requests_retired
+            out["requests_slo_met"] = self.requests_slo_met
+            out["goodput"] = (round(self.requests_slo_met / self.requests_retired, 4)
+                              if self.requests_retired else None)
+            out["slo"] = self.slo_monitor.status()
+        return out
+
+    def goodput(self) -> Optional[float]:
+        """Cumulative fraction of retired (non-cancelled) requests whose
+        per-request SLO-met flag was True; None without a policy or before
+        the first retirement. (The SLOMonitor additionally keeps a
+        sliding-window goodput for burn-rate/breach evaluation.)"""
+        if self.slo_policy is None or not self.requests_retired:
+            return None
+        return self.requests_slo_met / self.requests_retired
+
+    def reset_slo_accounting(self) -> None:
+        """Zero the goodput counters and restart the sliding-window monitor
+        (same policy). Benchmarks call this after warmup() so roll-out
+        traffic doesn't pollute goodput or the breach windows — the engine
+        owns every field involved, so new accounting state added here can't
+        silently desync external callers."""
+        self.requests_retired = 0
+        self.requests_slo_met = 0
+        if self.slo_policy is not None:
+            self.slo_monitor = SLOMonitor(self.slo_policy, source="serving")
 
     # -- scheduling loop --------------------------------------------------
     def _has_work(self) -> bool:
@@ -399,11 +442,15 @@ class ServingEngine:
         req.t_first = req.t_last = time.perf_counter()
         req.tokens.append(tok0)
         if obs_on:
+            util = round(self.cache.utilization(), 4)
             _obs_metrics.record_serve("prefills", event=True,
                                       request=req.request_id, bucket=bucket,
                                       prompt_len=L, ms=round((req.t_first - t0) * 1e3, 3),
-                                      pool_utilization=round(self.cache.utilization(), 4))
+                                      pool_utilization=util)
             _obs_metrics.record_serve("prefill_tokens", delta=L)
+            _obs_tel.observe("serve.prefill_ms", (req.t_first - t0) * 1e3)
+            _obs_tel.set_gauge("serve.pool_utilization", util)
+            _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
         if self._finished(req, tok0):
             self._retire(req)
             return
@@ -480,6 +527,9 @@ class ServingEngine:
             _obs_metrics.record_serve("tokens", delta=len(active))
             _obs_flight.record_step((t_now - t0) * 1e3, fn="serve_decode",
                                     active=len(active))
+            # online decode-iteration latency percentiles (unsampled, like
+            # the flight recorder — TT_OBS_SAMPLE only thins the spans)
+            _obs_tel.observe("serve.decode_ms", (t_now - t0) * 1e3)
         for i in active:
             req = self._slots[i]
             tok = int(nxt[i])
@@ -514,13 +564,42 @@ class ServingEngine:
             reason = "eos"
         else:
             reason = "length"
-        if _obs.enabled():
+        obs_on = _obs.enabled()
+        slo_met = None
+        if reason != "cancelled":
+            ttft_ms = ttft * 1e3
+            # a one-token request has no between-token interval: exclude it
+            # from the tbot population (online AND offline percentiles use
+            # the same rule) rather than stream a 0.0 placeholder
+            tbot_ms = tbot * 1e3 if n_new > 1 else None
+            if self.slo_policy is not None:
+                slo_met = self.slo_policy.request_met(ttft_ms, tbot_ms)
+                self.requests_retired += 1
+                self.requests_slo_met += int(slo_met)
+            if obs_on:
+                # streaming percentiles: the online mirror of the offline
+                # serving section's TTFT/TBOT populations (cancelled
+                # requests excluded from both)
+                _obs_tel.observe("serve.ttft_ms", ttft_ms)
+                if tbot_ms is not None:
+                    _obs_tel.observe("serve.tbot_ms", tbot_ms)
+            if self.slo_monitor is not None:
+                self.slo_monitor.observe_request(
+                    ttft_ms=ttft_ms, tbot_ms=tbot_ms, met=bool(slo_met),
+                    tokens=n_new)
+        if obs_on:
+            util = round(self.cache.utilization(), 4)
+            _obs_tel.set_gauge("serve.pool_utilization", util)
+            _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
+            if self.slo_policy is not None and self.requests_retired:
+                _obs_tel.set_gauge(
+                    "serve.goodput",
+                    round(self.requests_slo_met / self.requests_retired, 4))
             _obs_metrics.record_serve(
                 "cancelled" if reason == "cancelled" else "retired",
                 event=True, request=req.request_id, n_new=n_new,
                 ttft_ms=round(ttft * 1e3, 3), tbot_ms=round(tbot * 1e3, 3),
-                finish=reason,
-                pool_utilization=round(self.cache.utilization(), 4))
+                finish=reason, pool_utilization=util)
         result = RequestResult(
             request_id=req.request_id,
             tokens=np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)]),
@@ -529,6 +608,7 @@ class ServingEngine:
             tbot_s=tbot,
             n_new_tokens=n_new,
             finish_reason=reason,
+            slo_met=slo_met,
         )
         try:
             # a cancel() from the caller thread can land at ANY point, so a

@@ -22,6 +22,7 @@ from .observability import events as _obs
 from .observability import flight_recorder as _obs_flight
 from .observability import metrics as _obs_metrics
 from .observability import runtime as _obs_runtime
+from .observability import telemetry as _obs_tel
 from .optim import global_norm as _global_norm
 from .robustness import faults as _rb_faults
 
@@ -118,7 +119,7 @@ class TrainStep:
     """
 
     def __init__(self, loss_module, optimizer, *, donate: bool = True, mesh_plan=None,
-                 guard=None):
+                 guard=None, slo=None):
         from . import jit as _jit
 
         if isinstance(loss_module, Module):
@@ -133,6 +134,23 @@ class TrainStep:
         # gate + grad-norm metric), so it is fixed at construction; the
         # CheckpointManager attaches itself via manager.attach(step)
         self._guard = guard
+        # live telemetry: an SLOPolicy (observability/slo.py) gets a
+        # sliding-window monitor over step wall time and tokens/s (via
+        # policy.tokens_per_step); breaches land on the bus reason-coded.
+        # Without one the per-step cost is a single `is None` test.
+        self.slo_monitor = None
+        if slo is not None:
+            from .observability.slo import SLOMonitor
+
+            if slo.min_tokens_per_s is not None and not slo.tokens_per_step:
+                # a training step has no per-request token count; without
+                # tokens_per_step the throughput target would silently never
+                # be evaluated — the operator would believe it enforced
+                raise ValueError(
+                    "SLOPolicy(min_tokens_per_s=...) on a TrainStep needs "
+                    "tokens_per_step=<batch tokens per step> to compute "
+                    "throughput")
+            self.slo_monitor = SLOMonitor(slo, source="training")
         self._jitted: Optional[Callable] = None
         self.opt_state = None
         self._step_count = 0
@@ -482,7 +500,8 @@ class TrainStep:
         # records (span + host_overhead) — the flight recorder stays
         # unsampled so its p99/spike detection keeps every step.
         obs_on = _obs.enabled()
-        t_host = time.perf_counter_ns() if obs_on else 0
+        slo_mon = self.slo_monitor
+        t_host = time.perf_counter_ns() if (obs_on or slo_mon is not None) else 0
         sampled = obs_on and _obs_runtime.step_sampled("train_step")
         self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
@@ -551,13 +570,19 @@ class TrainStep:
         for k, p in t_pairs:
             p.data = new_params[k]
         self._step_count += 1
-        if obs_on:
-            # flight recorder: every step's wall time (submission latency +
-            # any synchronous compile) feeds the bounded ring; spikes
-            # cross-reference the bus's recent recompile/stall events
-            _obs_flight.record_step(
-                (time.perf_counter_ns() - t_host) / 1e6,
-                step=self._step_count, fn="train_step")
+        if obs_on or slo_mon is not None:
+            wall_ms = (time.perf_counter_ns() - t_host) / 1e6
+            if obs_on:
+                # flight recorder: every step's wall time (submission latency
+                # + any synchronous compile) feeds the bounded ring; spikes
+                # cross-reference the bus's recent recompile/stall events.
+                # The streaming histogram is equally unsampled: online
+                # step-time percentiles must cover every step.
+                _obs_flight.record_step(wall_ms, step=self._step_count,
+                                        fn="train_step")
+                _obs_tel.observe("train.step_ms", wall_ms)
+            if slo_mon is not None:
+                slo_mon.observe_step(wall_ms)
         if gmetrics is not None:
             # host half of the guard: one device sync, then policy
             # (raise / skip-with-budget / rollback via the manager)

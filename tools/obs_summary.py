@@ -241,6 +241,28 @@ def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     return lines
 
 
+def slo_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """SLO section: breach counters plus the most recent reason-coded
+    slo.breach / slo.recovered events (observability/slo.py)."""
+    breach_counters = {k: v for k, v in counters.items()
+                       if k.startswith("slo.breach.")}
+    evs = [r for r in recs if r.get("kind") == "event"
+           and r.get("name") in ("slo.breach", "slo.recovered")]
+    if not breach_counters and not evs:
+        return []
+    lines = []
+    for k, v in sorted(breach_counters.items()):
+        lines.append(f"  {k.removeprefix('slo.breach.'):<24} x{v}")
+    for r in evs[-8:]:
+        a = r.get("attrs", {})
+        kind = "BREACH" if r["name"] == "slo.breach" else "recovered"
+        burn = f" burn={a['burn_rate']}x" if a.get("burn_rate") is not None else ""
+        lines.append(f"    @{r['ts_ms']:.0f}ms  {kind:<10} {a.get('reason', '?'):<14} "
+                     f"value={a.get('value')} target={a.get('target')}{burn} "
+                     f"[{a.get('source', '?')}]")
+    return lines
+
+
 def device_profiles(recs: list[dict]) -> list[dict]:
     return [r["attrs"]["profile"] for r in recs
             if r.get("kind") == "event" and r.get("name") == "device_profile"
@@ -319,8 +341,12 @@ def render(recs: list[dict], top: int = 0) -> str:
     serving = serving_lines(recs, counters)
     if serving:
         out += ["", "== serving ==", *serving]
+    slo = slo_lines(recs, counters)
+    if slo:
+        out += ["", "== slo ==", *slo]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.") and not k.startswith("serve.")
+             and not k.startswith("slo.breach.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]

@@ -1,0 +1,198 @@
+"""Perf regression gate: compare a fresh bench artifact against a baseline.
+
+Turns the committed bench rows (BENCH_SERVE.json, BENCH_LATEST.jsonl, any
+bench.py/benchmark_serving.py output) into a CI gate: rows are matched by
+their ``metric`` string, every known-direction numeric key is compared
+against the baseline with a tolerance band, and the process exits non-zero
+when anything regressed — so an MFU push (ROADMAP #5) or a scheduler change
+fails loudly instead of silently eroding BENCH history.
+
+Direction vocabulary (keys not listed are informational and never gated):
+
+  higher is better   value (the row's headline throughput), tokens/s,
+                     goodput, requests_per_s, requests_per_s_slo_met, mfu,
+                     mfu_measured, tflops_per_sec, vs_baseline
+  lower is better    ttft_ms_*, tbot_ms_*, compile_time_s,
+                     compile_time_warm_s, host_overhead_us, ms_per_token,
+                     recompiles_steady_state (zero-tolerance: any increase
+                     over the committed count is a regression)
+
+A relative band (default ±10%) plus, for millisecond latencies, an absolute
+slack floor (default 1.0 ms) keeps sub-millisecond jitter on fast CPUs from
+tripping the gate; ``recompiles_steady_state`` gets no band at all.
+
+Usage:
+    python tools/perf_gate.py --check BENCH_SERVE.json
+        # self-compare smoke: exercises load + compare, exits 0
+    python tools/perf_gate.py --baseline BENCH_SERVE.json --current fresh.json
+    python tools/perf_gate.py --baseline BENCH_LATEST.jsonl --current new.jsonl \
+        --tolerance 0.1 --slack-ms 1.0
+
+Exit codes: 0 no regression, 1 regression(s), 2 unusable input (missing
+file, no parseable rows, or no comparable metric between the artifacts).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
+                 "mfu", "mfu_measured", "tflops_per_sec", "vs_baseline",
+                 "baseline_tokens_per_sec")
+LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
+LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
+                "ms_per_token")
+ZERO_TOLERANCE = ("recompiles_steady_state",)
+
+
+def load_rows(path: str) -> list[dict]:
+    """Bench rows from a .json (one dict or a list) or .jsonl artifact."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            return [data]
+        if isinstance(data, list):
+            return [r for r in data if isinstance(r, dict)]
+    except json.JSONDecodeError:
+        pass
+    rows = []
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"# {path}: skipping malformed line {ln}", file=sys.stderr)
+            continue
+        if isinstance(rec, dict):
+            rows.append(rec)
+    return rows
+
+
+def _direction(key: str) -> Optional[str]:
+    if key in ZERO_TOLERANCE:
+        return "zero"
+    if key in HIGHER_BETTER:
+        return "up"
+    if key in LOWER_BETTER or any(key.startswith(p) for p in LOWER_BETTER_PREFIXES):
+        return "down"
+    return None
+
+
+def compare_rows(baseline: dict, current: dict, *, tolerance: float,
+                 slack_ms: float) -> list[dict]:
+    """Per-key verdicts for one matched row pair."""
+    out = []
+    for key, base in baseline.items():
+        direction = _direction(key)
+        if direction is None or not isinstance(base, (int, float)) \
+                or isinstance(base, bool):
+            continue
+        cur = current.get(key)
+        if not isinstance(cur, (int, float)) or isinstance(cur, bool):
+            continue
+        if direction == "zero":
+            ok = cur <= base
+            bound = base
+        elif direction == "up":
+            bound = base * (1.0 - tolerance)
+            ok = cur >= bound
+        else:
+            slack = slack_ms if "ms" in key else 0.0
+            bound = base * (1.0 + tolerance) + slack
+            ok = cur <= bound
+        delta = ((cur - base) / base) if base else None
+        out.append({"key": key, "baseline": base, "current": cur,
+                    "bound": round(bound, 4), "direction": direction,
+                    "delta": None if delta is None else round(delta, 4),
+                    "ok": ok})
+    return out
+
+
+def run_gate(baseline_rows: list[dict], current_rows: list[dict], *,
+             tolerance: float, slack_ms: float) -> tuple[int, int, list[str]]:
+    """(n_regressions, n_checked, report_lines) over metric-matched rows."""
+    cur_by_metric = {r.get("metric"): r for r in current_rows if r.get("metric")}
+    n_reg = 0
+    n_checked = 0
+    lines: list[str] = []
+    for brow in baseline_rows:
+        metric = brow.get("metric")
+        if not metric:
+            continue
+        crow = cur_by_metric.get(metric)
+        if crow is None:
+            lines.append(f"~ {metric}\n    (no matching row in current artifact "
+                         f"— not gated)")
+            continue
+        verdicts = compare_rows(brow, crow, tolerance=tolerance,
+                                slack_ms=slack_ms)
+        if not verdicts:
+            continue
+        n_checked += 1
+        bad = [v for v in verdicts if not v["ok"]]
+        n_reg += len(bad)
+        mark = "FAIL" if bad else "ok"
+        lines.append(f"{'!' if bad else ' '} [{mark}] {metric}")
+        for v in verdicts:
+            arrow = {"up": ">=", "down": "<=", "zero": "<="}[v["direction"]]
+            status = "REGRESSION" if not v["ok"] else ""
+            delta = "" if v["delta"] is None else f"  ({v['delta']:+.1%})"
+            lines.append(f"    {v['key']:<28} {v['current']:>12} vs baseline "
+                         f"{v['baseline']:>12}  (need {arrow} {v['bound']})"
+                         f"{delta}  {status}")
+    return n_reg, n_checked, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", metavar="ARTIFACT",
+                    help="self-compare one artifact (smoke: load + compare "
+                         "machinery, exits 0 unless the file is unusable)")
+    ap.add_argument("--baseline", help="committed baseline artifact (.json/.jsonl)")
+    ap.add_argument("--current", help="fresh artifact to gate")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative tolerance band (default 0.10 = ±10%%)")
+    ap.add_argument("--slack-ms", type=float, default=1.0, dest="slack_ms",
+                    help="absolute slack for *_ms latency keys (default 1.0)")
+    ns = ap.parse_args(argv)
+    if ns.check:
+        baseline_path = current_path = ns.check
+    elif ns.baseline and ns.current:
+        baseline_path, current_path = ns.baseline, ns.current
+    else:
+        ap.error("need --check ARTIFACT, or both --baseline and --current")
+    try:
+        baseline_rows = load_rows(baseline_path)
+        current_rows = load_rows(current_path)
+    except OSError as e:
+        print(f"error: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    if not baseline_rows or not current_rows:
+        print("error: no parseable bench rows "
+              f"(baseline={baseline_path}, current={current_path})", file=sys.stderr)
+        return 2
+    n_reg, n_checked, lines = run_gate(baseline_rows, current_rows,
+                                       tolerance=ns.tolerance,
+                                       slack_ms=ns.slack_ms)
+    print("\n".join(lines))
+    if n_checked == 0:
+        print("error: no comparable metric between baseline and current",
+              file=sys.stderr)
+        return 2
+    if n_reg:
+        print(f"\nperf gate: {n_reg} regression(s) across {n_checked} "
+              f"gated row(s)", file=sys.stderr)
+        return 1
+    print(f"\nperf gate: ok ({n_checked} row(s) gated, tolerance "
+          f"±{ns.tolerance:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
