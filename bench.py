@@ -212,6 +212,20 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         except Exception as e:
             print(f"# device profile failed ({model_name}): {e}", file=sys.stderr)
 
+    # static live-range peak-HBM estimate (analysis/memory.py): the
+    # trace-level prediction the measured device peak is judged against —
+    # estimator regressions gate like perf regressions (tools/perf_gate.py).
+    # Best-effort: an estimator failure must never take the bench row down.
+    mem_peak_estimated = None
+    try:
+        from thunder_tpu.analysis import budget as _budget
+
+        est = _budget.estimate_step_peak(step)
+        if est is not None:
+            mem_peak_estimated = est["peak_gb"]
+    except Exception as e:
+        print(f"# mem_peak_estimated failed ({model_name}): {e}", file=sys.stderr)
+
     return {
         "tps": tps,
         "loss": loss_val,
@@ -220,6 +234,7 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
         "peak_tflops": _peak_tflops(),
         "mem_gb": _mem_gb(step),
         "device_peak_gb": _device_peak_gb(),
+        "mem_peak_estimated": mem_peak_estimated,
         "host_overhead_us": host_overhead_us,
         "mfu_measured": None if mfu_measured is None else round(mfu_measured, 4),
         "device_breakdown": device_breakdown,
@@ -327,6 +342,10 @@ def _bench_row(model_name: str, B: int, T: int, iters: int, ckpt: bool = False) 
         "compile_time_s": fused.get("compile_time_s"),
         "compile_time_warm_s": compile_time_warm_s,
     }
+    # static peak-HBM estimate rides next to the measured figures so the
+    # estimator's accuracy (vs peak_hbm_gb) is visible in every artifact
+    if fused.get("mem_peak_estimated") is not None:
+        row["mem_peak_estimated"] = fused["mem_peak_estimated"]
     # measured-MFU columns ride only when the profiled window ran (BENCH_OBS=1)
     if fused.get("mfu_measured") is not None:
         row["mfu_measured"] = fused["mfu_measured"]

@@ -50,6 +50,11 @@ def pytest_configure(config):
         "telemetry: live-telemetry test (streaming percentiles, metrics "
         "exporter, SLO monitors, perf gate; filter with -m telemetry / "
         "-m 'not telemetry')")
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analysis test (trace verifier, pass-interposed "
+        "checking, alias/donation safety, memory budgeting; filter with "
+        "-m analysis / -m 'not analysis')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -39,3 +39,21 @@ def test_quickstart_runs(script):
     out = subprocess.run([sys.executable, path, *args], env=env,
                          capture_output=True, text=True, timeout=900, cwd=REPO)
     assert out.returncode == 0, f"{script} failed:\n{out.stderr[-1500:]}"
+
+
+@pytest.mark.analysis
+@pytest.mark.parametrize("script", ["pretrain.py", "continuous_batching.py"])
+def test_quickstart_runs_with_trace_checking(script):
+    """The verifier in the quickstarts' CI path: a training and a serving
+    quickstart run end-to-end with pass-interposed checking forced on —
+    every transform and executor pass verifies with zero violations (a
+    violation raises, failing the subprocess)."""
+    path = os.path.join(QS, script)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["TT_CHECK_TRACES"] = "1"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    out = subprocess.run([sys.executable, path], env=env,
+                         capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, (
+        f"{script} under TT_CHECK_TRACES=1 failed:\n{out.stderr[-1500:]}")

@@ -239,6 +239,10 @@ class ThunderCompiledFunction(EpilogueMixin):
         self._transforms: list[Transform] = list(cd.transforms)
         fn = cd.fn
         self.__name__ = getattr(fn, "__name__", type(fn).__name__)
+        # per-function trace checking (DebugOptions.check_traces) — the env
+        # switch TT_CHECK_TRACES covers every function at once
+        dbg = cd.compile_options.get("debug_options")
+        self._check_traces = bool(dbg is not None and getattr(dbg, "check_traces", False))
 
     # -- compilation pipeline (reference thunder/__init__.py:439-635) --
     def _compile(self, args, kwargs, key) -> CacheEntry:
@@ -262,22 +266,44 @@ class ThunderCompiledFunction(EpilogueMixin):
             phases.append(sp)
             cs.last_trace_tracing_time_ns = time.perf_counter_ns() - t0
 
+            # pass-interposed verification (thunder_tpu/analysis): under
+            # TT_CHECK_TRACES=1 (or DebugOptions(check_traces=True)) every
+            # pass's output trace is checked, blaming violations on the
+            # pass that produced them
+            from . import analysis as _an
+
+            chk = self._check_traces
+            _an.checkpoint("acquisition", trc, where=self.__name__, force=chk)
+
             t1 = time.perf_counter_ns()
             traces = [trc]
             pro = build_prologue(trc, tensor_mask, leaves)
+            _an.checkpoint("build_prologue", pro, where=self.__name__, force=chk)
 
             for tf in self._transforms:
                 with observability.span(f"transform:{type(tf).__name__}") as sp:
+                    prev, prev_pro = trc, pro
                     pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=cd)
                     sp.set(bsyms=len(trc.bound_symbols))
                 phases.append(sp)
                 traces.append(trc)
+                _an.checkpoint(f"transform:{type(tf).__name__}", trc, before=prev,
+                               where=self.__name__, force=chk)
+                if pro is not prev_pro:
+                    # transforms may rewrite the prologue too (e.g. pruning
+                    # checks); a corrupted prologue must blame its pass, not
+                    # surface as a baffling guard failure at dispatch
+                    _an.checkpoint(f"transform:{type(tf).__name__}:prologue", pro,
+                                   where=self.__name__, force=chk)
 
             with observability.span("transform:dce") as sp:
+                prev = trc
                 trc = dce(trc)
                 sp.set(bsyms=len(trc.bound_symbols))
             phases.append(sp)
             traces.append(trc)
+            _an.checkpoint("transform:dce", trc, before=prev, where=self.__name__,
+                           force=chk)
 
             from .executors.passes import transform_for_execution
 
@@ -286,7 +312,7 @@ class ThunderCompiledFunction(EpilogueMixin):
                 executors = [e for e in executors if not e.is_fusion_executor()]
             with observability.span("executor_dispatch",
                                     executors=[e.name for e in executors]) as sp:
-                ex_trc = transform_for_execution(trc, executors)
+                ex_trc = transform_for_execution(trc, executors, check_traces=chk)
                 sp.set(bsyms=len(ex_trc.bound_symbols),
                        fusions=sum(1 for b in ex_trc.bound_symbols
                                    if getattr(b.sym, "module", None) == "xla"))
@@ -295,9 +321,12 @@ class ThunderCompiledFunction(EpilogueMixin):
 
             for tf in self._transforms:
                 with observability.span(f"transform_post:{type(tf).__name__}") as sp:
+                    prev = ex_trc
                     ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=cd)
                 phases.append(sp)
                 traces.append(ex_trc)
+                _an.checkpoint(f"transform_post:{type(tf).__name__}", ex_trc,
+                               before=prev, where=self.__name__, force=chk)
 
             cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
 

@@ -1,0 +1,47 @@
+"""thunder_tpu.analysis — static analysis over the trace IR.
+
+A pass-manager-interposed verification framework (``TT_CHECK_TRACES=1`` or
+``DebugOptions(check_traces=True)``) plus standalone analyses:
+
+  verifier    core invariants: def-before-use, unique names, DEL liveness,
+              metadata stability, RETURN discipline, fusion-region
+              interfaces (recursing into subsymbols)
+  alias       alias/donation safety and mutation-effect ordering
+  reinfer     shape/dtype re-inference (rules + deep eval_shape mode)
+  budget      live-range memory estimation and the unified VMEM/HBM
+              budget API (the pallas checkers' fit decisions live here)
+  manager     the per-pass checkpoint with blame attribution
+
+See docs/analysis.md for the invariants reference and tools/trace_lint.py
+for the CLI that runs everything over a model pipeline.
+"""
+from __future__ import annotations
+
+from . import alias, errors, manager, reinfer, verifier
+from . import memory as budget
+from . import memory  # both names: `analysis.budget` is the documented API
+from .errors import TraceCheckError, minimized_repro, trace_excerpt
+from .manager import (
+    checkpoint,
+    clear_last_failure,
+    enabled,
+    last_failure,
+    override,
+    session,
+    take_last_failure,
+)
+from .verifier import (
+    CheckedListOfTraces,
+    check_inplace_into_fusion,
+    check_trace,
+    verify_trace,
+)
+
+__all__ = [
+    "TraceCheckError", "trace_excerpt", "minimized_repro",
+    "check_trace", "verify_trace", "check_inplace_into_fusion",
+    "CheckedListOfTraces",
+    "checkpoint", "enabled", "override", "session",
+    "last_failure", "take_last_failure", "clear_last_failure",
+    "alias", "budget", "memory", "reinfer", "verifier", "manager", "errors",
+]

@@ -1,0 +1,222 @@
+"""Alias / donation / effect-ordering analysis.
+
+The trace IR is functional — view-shaped ops (reshape/slice/transpose/...)
+and buffer writes (``copy_with_setitem``, ``index_put`` lowerings,
+``update_aliases``) all produce fresh proxies. The hazards this module
+guards are therefore *executor-level*: XLA may lower a functional write
+in place when the old buffer is dead (donation), and fusion scheduling may
+reorder a region's reads against a write. Three checks:
+
+- **donation safety**: a trace arg marked donated (``trace.donated`` or the
+  ``donated=`` parameter) must never be read — directly or through a view
+  alias — after the write that consumes its buffer. Under donation the old
+  array no longer exists; a read would observe freed/overwritten memory.
+- **stale alias reads** (``strict=True``): any read of a pre-write proxy
+  (or a view of it) after a write to its alias class. The interpreter
+  frontend's redirect table rewrites these at acquisition
+  (tests/test_update_aliases.py), so one surviving into a trace means a
+  transform resurrected a stale name. Strict because semantically legal in
+  a purely functional reading — run under deep checking and trace_lint.
+- **effect ordering** (cross-pass, see manager.py): mutation-effect ops and
+  the ``trace.side_effects`` replay list must keep their program order
+  across transforms — autodiff/remat/fusion may move pure compute freely,
+  but reordering buffer writes (fp8 amax updates, running stats, the
+  StepGuard's gated skip) changes observable state.
+"""
+from __future__ import annotations
+
+from ..core.prims import PrimIDs
+from ..core.proxies import Proxy, TensorProxy
+from ..core.symbol import OpTags
+from ..core.trace import TraceCtx
+from . import errors as E
+from .errors import TraceCheckError
+
+# ops whose output aliases (a view of) their first tensor arg, for the
+# purpose of donation tracking: reading a reshape of a donated buffer after
+# donation is as invalid as reading the buffer itself
+_VIEW_IDS = frozenset({
+    PrimIDs.RESHAPE, PrimIDs.TRANSPOSE, PrimIDs.BROADCAST_IN_DIM,
+    PrimIDs.SLICE, PrimIDs.SQUEEZE,
+})
+
+# ops that (may) write the buffer of their first tensor arg when lowered
+_MUTATING_IDS = frozenset({PrimIDs.COPY_WITH_SETITEM, PrimIDs.UPDATE_ALIASES})
+
+
+def _first_tensor(bsym):
+    for p in bsym.flat_proxy_args():
+        if isinstance(p, TensorProxy):
+            return p
+    return None
+
+
+def is_mutating(bsym) -> bool:
+    return (bsym.sym.id in _MUTATING_IDS
+            or OpTags.IN_PLACE in bsym.sym.tags or OpTags.IN_PLACE in bsym.tags)
+
+
+def mutated_dests(bsym) -> list:
+    """Tensor args whose underlying buffer the op (may) write."""
+    if bsym.sym.id == PrimIDs.UPDATE_ALIASES:
+        return [p for p in bsym.flat_proxy_args() if isinstance(p, TensorProxy)]
+    dest = _first_tensor(bsym)
+    return [dest] if dest is not None else []
+
+
+def effect_signature(trace: TraceCtx) -> list[tuple]:
+    """Ordered effect keys of a trace: one entry per mutation-effect op
+    (op name + destination proxy name) followed by the side-effect replay
+    list (owner-attr + proxy name). Two traces related by a pass must agree
+    on the relative order of their common entries."""
+    sig: list[tuple] = []
+    for bsym in trace.bound_symbols:
+        if is_mutating(bsym):
+            for d in mutated_dests(bsym):
+                sig.append(("op", bsym.sym.name, d.name))
+    for owner, name, p in getattr(trace, "side_effects", ()):
+        sig.append(("side_effect", name, p.name if isinstance(p, Proxy) else repr(p)))
+    return sig
+
+
+def check_effect_order(before: TraceCtx, after: TraceCtx) -> None:
+    """The common effect entries of ``after`` must appear in the same
+    relative order as in ``before``. Entries may be added or dropped by a
+    pass (new effects, DCE'd dead effects) — but never reordered."""
+    sig_b = effect_signature(before)
+    sig_a = effect_signature(after)
+    if not sig_b or not sig_a:
+        return
+    from collections import Counter
+
+    common = Counter(sig_b) & Counter(sig_a)
+    if not common:
+        return
+
+    def filtered(sig):
+        budget = Counter(common)
+        out = []
+        for k in sig:
+            if budget[k] > 0:
+                budget[k] -= 1
+                out.append(k)
+        return out
+
+    fb, fa = filtered(sig_b), filtered(sig_a)
+    if fb != fa:
+        # find the first divergence for the diagnostic
+        idx = next((i for i, (x, y) in enumerate(zip(fb, fa)) if x != y), 0)
+        # anchor the blame at the bsym carrying the effect AT the divergence
+        # position (not just any bsym matching the key — the same op/dest
+        # pair can occur many times in a large trace)
+        bsym_index = None
+        keyed: list[tuple] = []  # (key, bsym_index|None) in signature order
+        for i, bsym in enumerate(after.bound_symbols):
+            if is_mutating(bsym):
+                for d in mutated_dests(bsym):
+                    keyed.append((("op", bsym.sym.name, d.name), i))
+        for owner, name, p in getattr(after, "side_effects", ()):
+            keyed.append((("side_effect", name,
+                           p.name if isinstance(p, Proxy) else repr(p)), None))
+        budget = Counter(common)
+        pos = 0
+        for key, i in keyed:
+            if budget[key] > 0:
+                budget[key] -= 1
+                if pos == idx:
+                    bsym_index = i
+                    break
+                pos += 1
+        raise TraceCheckError(
+            f"effect order changed across pass: expected {fb[idx]} at "
+            f"position {idx} of the common effect sequence, found {fa[idx]} "
+            f"(mutation effects must keep program order)",
+            kind=E.KIND_EFFECT_REORDER, bsym_index=bsym_index,
+            trace_name=after.name_of_fn())
+
+
+def check_alias_safety(trace: TraceCtx, donated=None, *, strict: bool = False) -> None:
+    """Donation safety (always) and stale-alias reads (``strict=True``).
+
+    ``donated``: iterable of trace-arg names whose buffers the runtime
+    donates (defaults to ``trace.donated`` when the trace carries one).
+    """
+    if donated is None:
+        donated = getattr(trace, "donated", ())
+    donated = set(donated)
+
+    # union-find over proxy names: view outputs join their source's class
+    parent: dict[str, str] = {}
+
+    def find(n: str) -> str:
+        parent.setdefault(n, n)
+        while parent[n] != n:
+            parent[n] = parent[parent[n]]
+            n = parent[n]
+        return n
+
+    def union(a: str, b: str) -> None:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    # class root -> (index of first write, written proxy name, new proxy names)
+    written: dict[str, tuple] = {}
+
+    for i, bsym in enumerate(trace.bound_symbols):
+        if bsym.sym.id in (PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL):
+            continue
+        # reads first: the write's own operands are pre-write by definition
+        for p in bsym.flat_proxy_args():
+            if not isinstance(p, TensorProxy):
+                continue
+            root = find(p.name)
+            w = written.get(root)
+            if w is None:
+                continue
+            j, dest_name, post_names = w
+            if p.name in post_names:
+                continue  # reading the post-write value: fine
+            donated_hit = sorted(n for n in donated if find(n) == root)
+            if donated_hit:
+                raise TraceCheckError(
+                    f"bsym {i} ({bsym.sym.name}) reads '{p.name}' after the "
+                    f"donated buffer of arg '{donated_hit[0]}' was consumed by "
+                    f"the write at bsym {j} (read-after-donation: the array "
+                    f"no longer exists under buffer donation)",
+                    kind=E.KIND_DONATION_READ, bsym_index=i,
+                    trace_name=trace.name_of_fn())
+            if strict:
+                raise TraceCheckError(
+                    f"bsym {i} ({bsym.sym.name}) reads stale proxy '{p.name}' "
+                    f"after its buffer was written at bsym {j} "
+                    f"('{dest_name}' -> {sorted(post_names)}); an executor "
+                    f"lowering the write in place would serve the new value",
+                    kind=E.KIND_STALE_ALIAS_READ, bsym_index=i,
+                    trace_name=trace.name_of_fn())
+        if bsym.sym.id in _VIEW_IDS:
+            src = _first_tensor(bsym)
+            if src is not None:
+                root = find(src.name)
+                w = written.get(root)
+                for o in bsym.flat_proxy_outs():
+                    if isinstance(o, TensorProxy):
+                        union(o.name, src.name)
+                        if w is not None and src.name in w[2]:
+                            # a view of the POST-write value is itself
+                            # post-write: reading it later is legal
+                            w[2].add(o.name)
+        elif is_mutating(bsym):
+            post = {o.name for o in bsym.flat_proxy_outs() if isinstance(o, TensorProxy)}
+            for d in mutated_dests(bsym):
+                root = find(d.name)
+                if root in written:
+                    # accumulate later writes; keep the FIRST write index
+                    j, dest_name, post_names = written[root]
+                    written[root] = (j, dest_name, post_names | post)
+                else:
+                    written[root] = (i, d.name, set(post))
+                # the new proxy continues the alias class (its buffer is the
+                # same storage when lowered in place)
+                for o in post:
+                    union(o, d.name)

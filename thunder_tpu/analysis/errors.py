@@ -1,0 +1,172 @@
+"""Structured trace-check errors and failure rendering.
+
+``TraceCheckError`` is the single error type every analysis raises. It
+remains an ``AssertionError`` subclass (anything catching the old
+``utils.check_trace.TraceCheckError`` keeps working) but now carries the
+full blame context a debugging session needs: which trace, which PASS
+introduced the violation, which ``BoundSymbol`` index it anchors to, a
+rendered excerpt of the trace around that index, and a printable minimized
+repro (the backward slice feeding the offending bsym).
+
+The pass manager (analysis/manager.py) fills in ``pass_name`` — analyses
+themselves only know the trace and the index.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+# machine-readable violation kinds (the analysis analog of the recompile
+# reason codes in observability/metrics.py)
+KIND_UNDEF_USE = "undef-use"
+KIND_USE_AFTER_DEL = "use-after-del"
+KIND_DUP_DEF = "dup-def"
+KIND_META_DRIFT = "meta-drift"
+KIND_NO_RETURN = "no-return"
+KIND_AFTER_RETURN = "after-return"
+KIND_BAD_ARG = "bad-arg"
+KIND_UNDEF_EFFECT = "undef-effect"
+KIND_EFFECT_REORDER = "effect-reorder"
+KIND_DONATION_READ = "donation-read"
+KIND_STALE_ALIAS_READ = "stale-alias-read"
+KIND_INPLACE_INTO_FUSION = "inplace-into-fusion"
+KIND_REGION_INTERFACE = "region-interface"
+KIND_REGION_BUDGET = "region-budget"
+KIND_REINFER = "reinfer-mismatch"
+
+KINDS = (
+    KIND_UNDEF_USE, KIND_USE_AFTER_DEL, KIND_DUP_DEF, KIND_META_DRIFT,
+    KIND_NO_RETURN, KIND_AFTER_RETURN, KIND_BAD_ARG, KIND_UNDEF_EFFECT,
+    KIND_EFFECT_REORDER, KIND_DONATION_READ, KIND_STALE_ALIAS_READ,
+    KIND_INPLACE_INTO_FUSION, KIND_REGION_INTERFACE, KIND_REGION_BUDGET,
+    KIND_REINFER,
+)
+
+
+class TraceCheckError(AssertionError):
+    """A trace invariant violation with blame context.
+
+    Fields (all optional — bare ``TraceCheckError("msg")`` still works for
+    the legacy call sites):
+      kind        machine-readable violation slug (one of ``KINDS``)
+      trace_name  ``trace.name_of_fn()`` of the failing trace
+      pass_name   the pass that produced the failing trace (set by the
+                  pass manager — the blame)
+      bsym_index  index of the offending BoundSymbol in the trace
+      excerpt     rendered trace lines around the offending bsym
+      repro       printable minimized repro (backward slice)
+      trace       the failing TraceCtx itself (for repro bundles)
+    """
+
+    def __init__(self, message: str, *, kind: Optional[str] = None,
+                 trace_name: Optional[str] = None, pass_name: Optional[str] = None,
+                 bsym_index: Optional[int] = None, excerpt: Optional[str] = None,
+                 repro: Optional[str] = None, trace=None):
+        super().__init__(message)
+        self.message = message
+        self.kind = kind
+        self.trace_name = trace_name
+        self.pass_name = pass_name
+        self.bsym_index = bsym_index
+        self.excerpt = excerpt
+        self.repro = repro
+        self.trace = trace
+
+    def with_blame(self, *, pass_name: str, trace=None) -> "TraceCheckError":
+        """Attach the pass that introduced this violation (and, when not
+        already carried, the failing trace + rendered excerpt)."""
+        self.pass_name = pass_name
+        if trace is not None and self.trace is None:
+            self.trace = trace
+            self.trace_name = self.trace_name or trace.name_of_fn()
+            if self.excerpt is None and self.bsym_index is not None:
+                self.excerpt = trace_excerpt(trace, self.bsym_index)
+            if self.repro is None and self.bsym_index is not None:
+                self.repro = minimized_repro(trace, self.bsym_index)
+        # rebuild args so str(e) shows the full diagnostic
+        self.args = (self.render(),)
+        return self
+
+    def render(self) -> str:
+        lines = [self.message]
+        ctx = []
+        if self.kind:
+            ctx.append(f"kind={self.kind}")
+        if self.trace_name:
+            ctx.append(f"trace={self.trace_name}")
+        if self.pass_name:
+            ctx.append(f"introduced by pass '{self.pass_name}'")
+        if self.bsym_index is not None:
+            ctx.append(f"bsym index {self.bsym_index}")
+        if ctx:
+            lines.append("  [" + ", ".join(ctx) + "]")
+        if self.excerpt:
+            lines.append("  trace excerpt:")
+            lines.extend("    " + ln for ln in self.excerpt.splitlines())
+        if self.repro:
+            lines.append("  minimized repro:")
+            lines.extend("    " + ln for ln in self.repro.splitlines())
+        return "\n".join(lines)
+
+    def __str__(self) -> str:  # pytest.raises(match=...) sees the full render
+        return self.render()
+
+
+def trace_excerpt(trace, index: int, context: int = 3) -> str:
+    """Printed trace lines around bsym ``index``, the offender marked."""
+    try:
+        from ..core.codeutils import ContextInterner
+
+        interner = ContextInterner()
+        out = []
+        lo = max(0, index - context)
+        hi = min(len(trace.bound_symbols), index + context + 1)
+        if lo > 0:
+            out.append(f"... ({lo} earlier bsyms)")
+        for i in range(lo, hi):
+            try:
+                lines = trace.bound_symbols[i].python_lines(i, interner)
+            except Exception:
+                lines = [f"<unprintable bsym {trace.bound_symbols[i].sym.name}>"]
+            mark = "-->" if i == index else "   "
+            for ln in lines or [f"<{trace.bound_symbols[i].sym.name}>"]:
+                out.append(f"{mark} [{i}] {ln}")
+        if hi < len(trace.bound_symbols):
+            out.append(f"... ({len(trace.bound_symbols) - hi} later bsyms)")
+        return "\n".join(out)
+    except Exception as e:  # the diagnostic must never mask the violation
+        return f"<excerpt unavailable: {type(e).__name__}: {e}>"
+
+
+def minimized_repro(trace, index: int, max_lines: int = 12) -> str:
+    """Backward slice feeding bsym ``index``: the smallest printable program
+    that reaches the offending op (producers of its args, transitively,
+    capped at ``max_lines``)."""
+    try:
+        from ..core.codeutils import ContextInterner
+
+        bsyms = trace.bound_symbols
+        if index >= len(bsyms):
+            return "<index out of range>"
+        keep = {index}
+        needed = {p.name for p in bsyms[index].flat_proxy_args()}
+        for i in range(index - 1, -1, -1):
+            outs = {o.name for o in bsyms[i].flat_proxy_outs()}
+            if outs & needed:
+                keep.add(i)
+                needed |= {p.name for p in bsyms[i].flat_proxy_args()}
+            if len(keep) >= max_lines:
+                break
+        interner = ContextInterner()
+        free = sorted(needed - {o.name for i in keep for o in bsyms[i].flat_proxy_outs()})
+        out = [f"def repro({', '.join(free)}):"]
+        for i in sorted(keep):
+            try:
+                lines = bsyms[i].python_lines(i, interner)
+            except Exception:
+                lines = [f"<unprintable {bsyms[i].sym.name}>"]
+            for ln in lines or [f"<{bsyms[i].sym.name}>"]:
+                out.append(f"  {ln}")
+        return "\n".join(out)
+    except Exception as e:
+        return f"<repro unavailable: {type(e).__name__}: {e}>"

@@ -1,0 +1,181 @@
+"""Pass-interposed verification: the checkpoint every pipeline calls
+between passes.
+
+Enablement (checked per call — compile-time only, never on the dispatch
+hot path):
+
+  TT_CHECK_TRACES=1   structural verifier + alias/donation + effect order
+                      + rule re-inference between every pass
+  TT_CHECK_TRACES=2   additionally: strict stale-alias reads and
+                      ``jax.eval_shape`` impl re-inference on claimed traces
+  DebugOptions(check_traces=True)   per-function force (level 1), threaded
+                      through ``jit(..., debug_options=...)``
+
+On a violation the checkpoint attributes blame: the previous checkpoint
+verified the pass's input, so the failing pass is the one that produced
+this trace. The raised :class:`TraceCheckError` names the pass, the bsym
+index, a trace excerpt, and a minimized repro; the observability bus gets
+an ``analysis.violations`` counter bump and a ``trace_check_failed`` event
+(``analysis.checks`` counts clean checkpoints).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from ..observability import events as _obs
+from . import alias as _alias
+from . import memory as _memory
+from . import reinfer as _reinfer
+from . import verifier as _verifier
+from .errors import TraceCheckError
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+# test/tool override: None -> env decides
+_OVERRIDE: list = [None]
+
+
+def enabled() -> int:
+    """Checking level: 0 off, 1 standard, 2 deep."""
+    if _OVERRIDE[0] is not None:
+        return _OVERRIDE[0]
+    v = os.environ.get("TT_CHECK_TRACES", "").strip().lower()
+    if not v or v == "0":
+        return 0
+    if v.isdigit():
+        # any level >= 2 means deep; never silently run LESS checking than
+        # the user asked for
+        return 2 if int(v) >= 2 else 1
+    return 1 if v in _TRUTHY else 0
+
+
+@contextmanager
+def override(level: Optional[int]):
+    """Force the checking level for a scope (trace_lint, tests)."""
+    prev = _OVERRIDE[0]
+    _OVERRIDE[0] = level
+    try:
+        yield
+    finally:
+        _OVERRIDE[0] = prev
+
+
+# -- session collection (trace_lint / tests read per-checkpoint rows) --------
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.session = None
+
+
+_STATE = _State()
+_LAST_FAILURE: list = [None]
+
+
+class Session:
+    """Collects one row per checkpoint while installed (see ``session()``)."""
+
+    def __init__(self, estimate_memory: bool = False):
+        self.rows: list[dict] = []
+        self.estimate_memory = estimate_memory
+        self.checks = 0
+        self.violations = 0
+
+    def record(self, row: dict) -> None:
+        self.rows.append(row)
+
+
+@contextmanager
+def session(estimate_memory: bool = False):
+    s = Session(estimate_memory=estimate_memory)
+    prev = _STATE.session
+    _STATE.session = s
+    try:
+        yield s
+    finally:
+        _STATE.session = prev
+
+
+def last_failure() -> Optional[TraceCheckError]:
+    """The most recent checkpoint violation (inspection; does not consume)."""
+    return _LAST_FAILURE[0]
+
+
+def take_last_failure() -> Optional[TraceCheckError]:
+    """The most recent checkpoint violation, consumed: repro bundles use
+    this so a failure is attached to at most ONE bundle — a stale failure
+    from hours ago must not ride into every later, unrelated reproducer."""
+    e, _LAST_FAILURE[0] = _LAST_FAILURE[0], None
+    return e
+
+
+def clear_last_failure() -> None:
+    _LAST_FAILURE[0] = None
+
+
+# -- the checkpoint itself ---------------------------------------------------
+
+
+def checkpoint(pass_name: str, trace, *, before=None, where: Optional[str] = None,
+               force: bool = False, donated=None) -> None:
+    """Verify ``trace`` as the output of ``pass_name``.
+
+    ``before`` is the pass's input trace when the pass preserves proxy
+    names (enables the cross-pass effect-order check); ``where`` labels the
+    pipeline (function name) for diagnostics; ``force`` runs level-1 checks
+    regardless of the env (DebugOptions.check_traces).
+    """
+    level = enabled()
+    if not level and force:
+        level = 1
+    if not level:
+        return
+    sess = _STATE.session
+    try:
+        _verifier.verify_trace(trace)
+        _verifier.check_inplace_into_fusion(trace)
+        _alias.check_alias_safety(trace, donated=donated, strict=level >= 2)
+        _reinfer.reinfer_trace(trace)
+        budget = _memory.region_budget()
+        if budget is not None:
+            for r in _memory.region_peaks(trace):
+                if r["peak_bytes"] > budget:
+                    raise TraceCheckError(
+                        f"fusion region '{r['region']}' (bsym {r['index']}) has an "
+                        f"estimated live-range peak of {r['peak_bytes']} bytes, over "
+                        f"the configured region budget of {budget} bytes",
+                        kind="region-budget", bsym_index=r["index"],
+                        trace_name=trace.name_of_fn())
+        if level >= 2:
+            _reinfer.reinfer_executed(trace)
+        if before is not None:
+            _alias.check_effect_order(before, trace)
+    except TraceCheckError as e:
+        e.with_blame(pass_name=pass_name, trace=trace)
+        _LAST_FAILURE[0] = e
+        if sess is not None:
+            sess.violations += 1
+            sess.record({"pass": pass_name, "where": where,
+                         "bsyms": len(trace.bound_symbols),
+                         "status": f"VIOLATION [{e.kind}] at bsym {e.bsym_index}"})
+        if _obs.enabled():
+            _obs.inc("analysis.violations")
+            _obs.event("trace_check_failed", pass_name=pass_name, where=where,
+                       kind=e.kind, bsym_index=e.bsym_index,
+                       trace=trace.name_of_fn(), message=e.message[:300])
+        raise
+    if sess is not None:
+        sess.checks += 1
+        row = {"pass": pass_name, "where": where,
+               "bsyms": len(trace.bound_symbols), "status": "ok"}
+        if sess.estimate_memory:
+            try:
+                row["peak_bytes"] = _memory.peak_bytes(trace).peak_bytes
+            except Exception:
+                pass
+        sess.record(row)
+    if _obs.enabled():
+        _obs.inc("analysis.checks")

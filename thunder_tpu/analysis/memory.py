@@ -1,0 +1,331 @@
+"""Live-range memory estimation and the unified budget API.
+
+Two layers:
+
+- **live-range estimator**: per-bsym liveness over a trace's tensor proxies
+  -> a peak-HBM estimate (``peak_bytes``), per fusion region too
+  (``region_peaks``). This is a static upper bound on what the compiled
+  program needs resident at once (XLA may do better via rematerialization
+  and buffer sharing; it cannot do worse than the sum of simultaneously
+  live values plus what it chooses to duplicate).
+- **budget API**: the one place VMEM/HBM fit decisions live. The ad-hoc
+  estimate-and-decline checkers that grew inside ``executors/pallasex.py``
+  (flash block capping, paged-attention working-set decline) now call
+  through here, so every kernel/fusion budget question — "does this region
+  fit VMEM?", "what is this step's peak HBM?" — has a single answer with a
+  single set of knobs.
+
+Env knobs: ``TT_VMEM_LIMIT`` (per-core VMEM budget for region checks,
+default 16 MiB — the v4/v5 scoped-VMEM figure the flash kernels were swept
+against), ``TT_PAGED_VMEM_LIMIT`` (paged-decode claim budget, default
+14 MiB, kept from pallasex), ``TT_CHECK_REGION_BUDGET`` (bytes; when set,
+the pass checkpoints flag any fusion region whose live-range peak exceeds
+it).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+from ..core.prims import PrimIDs
+from ..core.proxies import TensorProxy
+from ..core.trace import TraceCtx
+
+# ---------------------------------------------------------------------------
+# budgets / knobs
+# ---------------------------------------------------------------------------
+
+DEFAULT_VMEM_LIMIT = 16 * 2**20
+DEFAULT_PAGED_VMEM_LIMIT = 14 * 2**20
+
+
+def vmem_limit() -> int:
+    return int(os.environ.get("TT_VMEM_LIMIT", str(DEFAULT_VMEM_LIMIT)))
+
+
+def paged_vmem_limit() -> int:
+    return int(os.environ.get("TT_PAGED_VMEM_LIMIT", str(DEFAULT_PAGED_VMEM_LIMIT)))
+
+
+def within_vmem(nbytes: int, limit: Optional[int] = None) -> bool:
+    """The fit decision: does an estimated working set fit the VMEM budget?"""
+    return int(nbytes) <= (vmem_limit() if limit is None else int(limit))
+
+
+def region_budget() -> Optional[int]:
+    """Optional per-fusion-region HBM budget the pass checkpoints enforce
+    (None = report only). Set via ``set_region_budget`` or
+    ``TT_CHECK_REGION_BUDGET=<bytes>``."""
+    if _REGION_BUDGET[0] is not None:
+        return _REGION_BUDGET[0]
+    v = os.environ.get("TT_CHECK_REGION_BUDGET")
+    return int(v) if v else None
+
+
+def set_region_budget(nbytes: Optional[int]) -> None:
+    _REGION_BUDGET[0] = None if nbytes is None else int(nbytes)
+
+
+_REGION_BUDGET: list = [None]
+
+
+# ---------------------------------------------------------------------------
+# kernel working-set estimates (moved from executors/pallasex.py)
+# ---------------------------------------------------------------------------
+
+
+def paged_decode_vmem_bytes(page_size: int, D: int, g: int,
+                            kv_itemsize: int, q_itemsize: int) -> int:
+    """Estimated per-program VMEM working set of the paged-attention decode
+    kernel: double-buffered k/v page blocks, the q group block, and the f32
+    accumulator/output tiles (pallasex `_paged_attn_kernel`)."""
+    kv = 2 * (2 * page_size * D * kv_itemsize)  # k + v, double-buffered DMA
+    qb = g * D * q_itemsize
+    acc = g * D * 4 + 2 * g * 4  # f32 acc + m/l scratch
+    out = g * D * q_itemsize
+    return kv + qb + acc + out
+
+
+def flash_block_cap(widest_itemsize: int, block_q: int, block_k: int,
+                    T: int, Tk: int) -> tuple[int, int]:
+    """Flash-attention block sizes are swept for bf16; 4-byte operands
+    double the VMEM working set and blow the scoped limit — cap both blocks
+    at 256 there (gcd keeps divisibility). The decision half of pallasex's
+    `_cap_blocks_for_dtype`."""
+    if widest_itemsize >= 4:
+        block_q = math.gcd(min(block_q, 256), T)
+        block_k = math.gcd(min(block_k, 256), Tk)
+    return block_q, block_k
+
+
+# ---------------------------------------------------------------------------
+# live-range analysis
+# ---------------------------------------------------------------------------
+
+
+def proxy_nbytes(p) -> int:
+    if not isinstance(p, TensorProxy):
+        return 0
+    return p.numel * p.dtype.bytes
+
+
+class PeakReport:
+    """Result of a live-range sweep over one trace (or region)."""
+
+    __slots__ = ("peak_bytes", "peak_index", "args_bytes", "output_bytes",
+                 "n_proxies", "live_at_peak", "timeline")
+
+    def __init__(self, peak_bytes, peak_index, args_bytes, output_bytes,
+                 n_proxies, live_at_peak, timeline=None):
+        self.peak_bytes = peak_bytes
+        self.peak_index = peak_index
+        self.args_bytes = args_bytes
+        self.output_bytes = output_bytes
+        self.n_proxies = n_proxies
+        self.live_at_peak = live_at_peak
+        # {bsym_index: live bytes while executing it}; filled when the
+        # sweep is asked for it (with_timeline=True)
+        self.timeline = timeline
+
+    def as_dict(self) -> dict:
+        return {"peak_bytes": self.peak_bytes, "peak_index": self.peak_index,
+                "args_bytes": self.args_bytes, "output_bytes": self.output_bytes,
+                "n_proxies": self.n_proxies,
+                "live_at_peak": list(self.live_at_peak)}
+
+    def __repr__(self) -> str:
+        return (f"PeakReport(peak={self.peak_bytes / 2**20:.2f} MiB "
+                f"at bsym {self.peak_index}, args={self.args_bytes / 2**20:.2f} MiB)")
+
+
+# view-shaped ops whose outputs alias their first tensor arg's buffer: a
+# view costs nothing but keeps the source buffer alive (the semantics of
+# the seed estimator utils/memory.py, which now delegates here)
+_VIEW_IDS = frozenset({PrimIDs.RESHAPE, PrimIDs.TRANSPOSE, PrimIDs.SQUEEZE,
+                       PrimIDs.BROADCAST_IN_DIM})
+
+
+def live_ranges(bsyms, args=()) -> dict[str, tuple[int, int, int]]:
+    """buffer name -> (def_index, last_use_index, nbytes) over a bsym list.
+
+    Args define at -1. DEL ends a range at the DEL's index; otherwise a
+    range ends at the last consuming bsym (RETURN counts as a use — outputs
+    stay live to the end). View outputs (reshape/transpose/squeeze/
+    broadcast) are 0-byte aliases: their reads extend the SOURCE buffer's
+    range instead of allocating, so a view-heavy trace is not over-priced.
+    """
+    ranges: dict[str, tuple[int, int, int]] = {}
+    alias_of: dict[str, str] = {}  # view name -> buffer (root) name
+
+    def root(n: str) -> str:
+        return alias_of.get(n, n)
+
+    for p in args:
+        if isinstance(p, TensorProxy):
+            ranges[p.name] = (-1, -1, proxy_nbytes(p))
+
+    def touch(p, i):
+        r = root(p.name)
+        if r in ranges:
+            d, _, nb = ranges[r]
+            ranges[r] = (d, i, nb)
+        else:  # consumed but never defined here (lenient: region views)
+            ranges[r] = (-1, i, proxy_nbytes(p))
+
+    for i, bsym in enumerate(bsyms):
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args():
+                # only a DEL of the buffer itself frees it; deleting a view
+                # name must not free a root that later reads still alias
+                if p.name in ranges and p.name not in alias_of:
+                    d, _, nb = ranges[p.name]
+                    ranges[p.name] = (d, i, nb)
+            continue
+        for p in bsym.flat_proxy_args():
+            if isinstance(p, TensorProxy):
+                touch(p, i)
+        is_view = bsym.sym.id in _VIEW_IDS
+        src = None
+        if is_view:
+            src = next((p for p in bsym.flat_proxy_args()
+                        if isinstance(p, TensorProxy)), None)
+        for o in bsym.flat_proxy_outs():
+            if not isinstance(o, TensorProxy):
+                continue
+            if is_view and src is not None:
+                alias_of[o.name] = root(src.name)
+            elif root(o.name) not in ranges:
+                ranges[o.name] = (i, i, proxy_nbytes(o))
+    return ranges
+
+
+def peak_bytes(trace_or_bsyms, args=None, *, count_args: bool = True,
+               with_timeline: bool = False) -> PeakReport:
+    """Sweep live ranges -> peak simultaneously-live bytes.
+
+    Accepts a TraceCtx or a raw bsym list (+ explicit args). Intermediates
+    live over [def, last_use (or DEL)]. Args live for the WHOLE trace
+    unless explicitly DEL'd — XLA holds non-donated input buffers for the
+    entire execution, so freeing them at last use would under-report.
+    ``count_args=False`` prices only the intermediates (callers that
+    account resident state separately, e.g. ``estimate_step_peak``).
+    """
+    if isinstance(trace_or_bsyms, TraceCtx):
+        bsyms = trace_or_bsyms.bound_symbols
+        args = trace_or_bsyms.args if args is None else args
+    else:
+        bsyms = list(trace_or_bsyms)
+        args = args or ()
+    ranges = live_ranges(bsyms, args)
+    n = len(bsyms)
+    deleted: set = set()
+    for bsym in bsyms:
+        if bsym.sym.id == PrimIDs.DEL:
+            deleted.update(p.name for p in bsym.flat_proxy_args())
+
+    def _end(name, d, last):
+        if d == -1 and name not in deleted:
+            return n - 1  # un-DEL'd args are held to the end
+        return last if last >= 0 else n - 1
+
+    delta = [0] * (n + 2)  # position p covers the state while executing bsym p
+    args_bytes = 0
+    for name, (d, last, nb) in ranges.items():
+        if nb == 0:
+            continue
+        if d == -1:
+            args_bytes += nb
+            if not count_args:
+                continue
+        delta[max(d, 0)] += nb
+        delta[_end(name, d, last) + 1] -= nb
+    peak = 0
+    peak_idx = 0
+    cur = 0
+    timeline: Optional[dict] = {} if with_timeline else None
+    for i in range(n + 1):
+        cur += delta[i]
+        if timeline is not None and i < n:
+            timeline[i] = cur
+        if cur > peak:
+            peak, peak_idx = cur, i
+    live_at_peak = sorted(
+        name for name, (d, last, nb) in ranges.items()
+        if nb and (count_args or d >= 0)
+        and max(d, 0) <= peak_idx <= _end(name, d, last))
+    out_bytes = 0
+    for bsym in reversed(bsyms):
+        if bsym.sym.id == PrimIDs.RETURN:
+            out_bytes = sum(proxy_nbytes(p) for p in bsym.flat_proxy_args()
+                            if isinstance(p, TensorProxy))
+            break
+    return PeakReport(peak, min(peak_idx, max(n - 1, 0)), args_bytes, out_bytes,
+                      len(ranges), live_at_peak[:16], timeline)
+
+
+def region_peaks(trace: TraceCtx) -> list[dict]:
+    """Live-range peak per executor fusion region of a claimed trace:
+    [{"index", "region", "executor", "interface_bytes", "peak_bytes"}]."""
+    out = []
+    for i, bsym in enumerate(trace.bound_symbols):
+        if not (bsym.subsymbols and bsym.sym.executor is not None):
+            continue
+        iface = sum(proxy_nbytes(p) for p in bsym.flat_proxy_args())
+        iface += sum(proxy_nbytes(p) for p in bsym.flat_proxy_outs())
+        rep = peak_bytes(list(bsym.subsymbols),
+                         [p for p in bsym.flat_proxy_args() if isinstance(p, TensorProxy)])
+        out.append({
+            "index": i,
+            "region": bsym.sym.name,
+            "executor": getattr(bsym.sym.executor, "name", str(bsym.sym.executor)),
+            "interface_bytes": iface,
+            "peak_bytes": rep.peak_bytes,
+        })
+    return out
+
+
+def estimate_step_peak(step) -> Optional[dict]:
+    """Peak-HBM estimate of a built TrainStep: resident state (params,
+    optimizer state, batch — priced once, from the live arrays) + the
+    larger of the forward/backward INTERMEDIATE live-range peaks
+    (``count_args=False``: the traces' args are those same param/batch
+    buffers and must not be double-counted; saved-for-backward residuals
+    are intermediates of the forward sweep that produces them).
+
+    Returns None when the step has not been built yet (no traces).
+    """
+    cs = getattr(step, "compile_stats", None)
+    if cs is None or not getattr(cs, "last_traces", None):
+        return None
+    import numpy as _np
+
+    def _arr_bytes(tree) -> int:
+        import jax
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "nbytes"):
+                total += int(leaf.nbytes)
+            elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                total += int(_np.prod(leaf.shape or (1,))) * _np.dtype(leaf.dtype).itemsize
+        return total
+
+    tparams, frozen, _ = step._split_arrays()
+    state_bytes = _arr_bytes(tparams) + _arr_bytes(frozen) + _arr_bytes(step.opt_state)
+    batch_bytes = _arr_bytes(getattr(step, "last_batch", ()))
+    fwd_peak = bwd_peak = 0
+    fwd_trc = cs.last_traces[-1]
+    fwd_peak = peak_bytes(fwd_trc, count_args=False).peak_bytes
+    bwd_traces = getattr(cs, "last_backward_traces", None)
+    if bwd_traces:
+        bwd_peak = peak_bytes(bwd_traces[-1], count_args=False).peak_bytes
+    total = state_bytes + batch_bytes + max(fwd_peak, bwd_peak)
+    return {
+        "state_bytes": state_bytes,
+        "batch_bytes": batch_bytes,
+        "fwd_peak_bytes": fwd_peak,
+        "bwd_peak_bytes": bwd_peak,
+        "peak_bytes": total,
+        "peak_gb": round(total / 2**30, 4),
+    }

@@ -1,0 +1,193 @@
+"""Core trace invariants — the verifier every pass checkpoint runs.
+
+Grown from the seed ``utils/check_trace.py`` (itself a re-design of
+reference thunder/dev_utils/check_trace.py:23): def-before-use, unique
+names, DEL liveness, metadata stability per name, RETURN discipline,
+side-effect proxy definedness — now extended to recurse into executor
+fusion regions and validate their interfaces against the contract
+``executors/passes.py``/``xlaex._make_fusion`` builds them with (every
+proxy a member consumes is a region input or produced by an earlier
+member; every region output is produced by a member or passed through).
+
+All violations raise :class:`analysis.errors.TraceCheckError` carrying the
+violation kind and the offending bsym index; the pass manager adds the
+blame (which pass produced the failing trace).
+"""
+from __future__ import annotations
+
+from ..core.prims import PrimIDs
+from ..core.proxies import Proxy, TensorProxy
+from ..core.trace import TraceCtx
+from . import errors as E
+from .errors import TraceCheckError
+
+
+def _meta_of(p) -> tuple:
+    return (tuple(p.shape), p.dtype)
+
+
+def verify_trace(trace: TraceCtx, *, check_regions: bool = True) -> None:
+    """Check the core well-formedness invariants of one trace.
+
+    Raises TraceCheckError (kind + bsym_index attached) on the first
+    violation; returns None on a clean trace.
+    """
+    defined: set[str] = {p.name for p in trace.args}
+    ever_defined: set[str] = set(defined)
+    produced_at: dict[str, int] = {}
+    meta: dict[str, tuple] = {}
+    deleted_at: dict[str, int] = {}
+    saw_return = False
+
+    def note_meta(p, i):
+        if isinstance(p, TensorProxy):
+            m = _meta_of(p)
+            prev = meta.get(p.name)
+            if prev is not None and prev != m:
+                raise TraceCheckError(
+                    f"proxy '{p.name}' changes metadata at bsym {i}: {prev} -> {m}",
+                    kind=E.KIND_META_DRIFT, bsym_index=max(i, 0),
+                    trace_name=trace.name_of_fn())
+            meta[p.name] = m
+
+    for p in trace.args:
+        if not isinstance(p, Proxy):
+            raise TraceCheckError(f"trace arg {p!r} is not a proxy",
+                                  kind=E.KIND_BAD_ARG, trace_name=trace.name_of_fn())
+        note_meta(p, -1)
+
+    for i, bsym in enumerate(trace.bound_symbols):
+        if saw_return:
+            raise TraceCheckError(
+                f"bsym {i} ({bsym.sym.name}) appears after RETURN",
+                kind=E.KIND_AFTER_RETURN, bsym_index=i, trace_name=trace.name_of_fn())
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args():
+                if p.name not in defined:
+                    where = deleted_at.get(p.name)
+                    extra = f" (already deleted at bsym {where})" if where is not None else ""
+                    raise TraceCheckError(
+                        f"DEL of undefined proxy {p.name} at bsym {i}{extra}",
+                        kind=E.KIND_USE_AFTER_DEL, bsym_index=i,
+                        trace_name=trace.name_of_fn())
+                defined.discard(p.name)
+                deleted_at[p.name] = i
+            continue
+        for p in bsym.flat_proxy_args():
+            if p.name not in defined:
+                if p.name in deleted_at:
+                    raise TraceCheckError(
+                        f"bsym {i} ({bsym.sym.name}) consumes proxy '{p.name}' "
+                        f"deleted at bsym {deleted_at[p.name]} (use-after-free)",
+                        kind=E.KIND_USE_AFTER_DEL, bsym_index=i,
+                        trace_name=trace.name_of_fn())
+                raise TraceCheckError(
+                    f"bsym {i} ({bsym.sym.name}) consumes undefined proxy '{p.name}'",
+                    kind=E.KIND_UNDEF_USE, bsym_index=i, trace_name=trace.name_of_fn())
+            note_meta(p, i)
+        own_args = {p.name for p in bsym.flat_proxy_args()}
+        for o in bsym.flat_proxy_outs():
+            if o.name in produced_at and o.name not in own_args:
+                # a bsym may re-emit one of its OWN inputs (a pure
+                # pass-through, e.g. a full-range getitem); anything else
+                # redefining a name is a clobber
+                raise TraceCheckError(
+                    f"proxy '{o.name}' produced twice "
+                    f"(bsyms {produced_at[o.name]} and {i})",
+                    kind=E.KIND_DUP_DEF, bsym_index=i,
+                    trace_name=trace.name_of_fn())
+            produced_at.setdefault(o.name, i)
+            defined.add(o.name)
+            ever_defined.add(o.name)
+            note_meta(o, i)
+        if check_regions and bsym.subsymbols and bsym.sym.executor is not None:
+            _verify_region(trace, bsym, i)
+        if bsym.sym.id == PrimIDs.RETURN:
+            saw_return = True
+
+    if not saw_return and trace.bound_symbols:
+        raise TraceCheckError("trace has no RETURN", kind=E.KIND_NO_RETURN,
+                              trace_name=trace.name_of_fn())
+
+    # side-effect (epilogue) proxies must be defined somewhere in the trace
+    for owner, name, p in getattr(trace, "side_effects", ()):
+        if isinstance(p, Proxy) and p.name not in ever_defined:
+            raise TraceCheckError(
+                f"side effect ({type(owner).__name__}.{name}) references "
+                f"undefined proxy '{p.name}'",
+                kind=E.KIND_UNDEF_EFFECT, trace_name=trace.name_of_fn())
+
+
+def _verify_region(trace: TraceCtx, bsym, index: int) -> None:
+    """Interface + internal dataflow of one executor fusion region.
+
+    The contract (xlaex._make_fusion / passes.py fusion_pass): region inputs
+    are exactly the proxies members consume that no earlier member produced;
+    region outputs are member-produced proxies consumed later (or passed
+    through). A transform that rewrites a region's args/outputs without
+    rewriting its subsymbols (or vice versa) breaks this and produces
+    programs that compute garbage or crash inside XLA.
+    """
+    region = bsym.sym.name
+    inputs = {p.name for p in bsym.flat_proxy_args()}
+    local: set[str] = set(inputs)
+    produced: set[str] = set()
+    for j, sub in enumerate(bsym.subsymbols):
+        if sub.sym.id in (PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL):
+            continue
+        for p in sub.flat_proxy_args():
+            if p.name not in local:
+                raise TraceCheckError(
+                    f"fusion region '{region}' (bsym {index}) member {j} "
+                    f"({sub.sym.name}) consumes '{p.name}', which is neither a "
+                    f"region input nor produced by an earlier member "
+                    f"(region interface violation)",
+                    kind=E.KIND_REGION_INTERFACE, bsym_index=index,
+                    trace_name=trace.name_of_fn())
+        for o in sub.flat_proxy_outs():
+            local.add(o.name)
+            produced.add(o.name)
+    for o in bsym.flat_proxy_outs():
+        if o.name not in produced and o.name not in inputs:
+            raise TraceCheckError(
+                f"fusion region '{region}' (bsym {index}) claims output "
+                f"'{o.name}' that no member produces (region interface violation)",
+                kind=E.KIND_REGION_INTERFACE, bsym_index=index,
+                trace_name=trace.name_of_fn())
+
+
+def check_trace(trace: TraceCtx) -> None:
+    """Seed-compatible entry point (utils/check_trace.py API)."""
+    verify_trace(trace)
+
+
+def check_inplace_into_fusion(trace: TraceCtx) -> None:
+    """A fusion region must not consume a tensor that a later
+    copy_with_setitem mutates (reference _inplace_copy_sanity_check,
+    thunder/core/transform_common.py:68) — the fused program would read
+    either value depending on scheduling."""
+    fusion_reads: dict[str, int] = {}
+    for i, bsym in enumerate(trace.bound_symbols):
+        is_fusion = str(getattr(bsym.sym, "module", "")) == "xla" or "fusion" in bsym.sym.name
+        if is_fusion:
+            for p in bsym.flat_proxy_args():
+                fusion_reads.setdefault(p.name, i)
+        if bsym.sym.id == PrimIDs.COPY_WITH_SETITEM or bsym.sym.name == "copy_with_setitem":
+            for p in bsym.flat_proxy_args()[:1]:
+                j = fusion_reads.get(p.name)
+                if j is not None and j < i:
+                    raise TraceCheckError(
+                        f"in-place copy at bsym {i} mutates '{p.name}' consumed "
+                        f"by fusion at bsym {j}",
+                        kind=E.KIND_INPLACE_INTO_FUSION, bsym_index=i,
+                        trace_name=trace.name_of_fn())
+
+
+class CheckedListOfTraces(list):
+    """List that validates traces as they are appended (reference
+    thunder/__init__.py:467 wraps trace history this way)."""
+
+    def append(self, trace):
+        check_trace(trace)
+        check_inplace_into_fusion(trace)
+        super().append(trace)

@@ -166,6 +166,12 @@ def from_trace(trace: TraceCtx) -> TraceCtx:
     t._name = trace._name
     t.tags = set(trace.tags)
     t.side_effects = list(trace.side_effects)
+    # donated-buffer annotation (arg names whose buffers the runtime
+    # donates) rides through every pass so the alias analysis
+    # (analysis/alias.py) can check read-after-donation at each checkpoint
+    donated = getattr(trace, "donated", None)
+    if donated:
+        t.donated = set(donated)
     return t
 
 

@@ -54,12 +54,12 @@ def _cap_blocks_for_dtype(q, block_q: int, block_k: int, T: int, Tk: int, *extra
     """Block sizes are swept for bf16; 4-byte operands (f32 paths: a
     no-autocast train step, or mixed-precision rewrites that leave SOME of
     q/k/v/do f32) double the VMEM working set and blow the 16M scoped limit —
-    cap both blocks at 256 there (gcd keeps divisibility)."""
+    cap both blocks at 256 there (gcd keeps divisibility). The decision
+    lives in the unified budget API (analysis/memory.py flash_block_cap)."""
+    from ..analysis import budget as _budget
+
     widest = max(jnp.dtype(t.dtype).itemsize for t in (q,) + tuple(extra))
-    if widest >= 4:
-        block_q = math.gcd(min(block_q, 256), T)
-        block_k = math.gcd(min(block_k, 256), Tk)
-    return block_q, block_k
+    return _budget.flash_block_cap(widest, block_q, block_k, T, Tk)
 NEG_INF = -1e30
 LOG2E = 1.4426950408889634  # 1/ln 2: base-2 softmax folds this into the scale
 LN2 = 0.6931471805599453
@@ -1539,18 +1539,15 @@ ex.register_implementation("quant.linear_nf4_kl", _nf4_kl_impl,
 # decode working set is small (one page pair + one q group per program), but
 # absurd page_size x D configs must fall back, not fail-to-compile: estimate
 # VMEM like _cap_blocks_for_dtype and decline the claim over the budget
-# (ADVICE r5: estimate + automatic fallback instead of an env escape hatch)
-_PAGED_VMEM_LIMIT = int(os.environ.get("TT_PAGED_VMEM_LIMIT", str(14 * 2**20)))
+# (ADVICE r5: estimate + automatic fallback instead of an env escape hatch).
+# Both the estimate formula and the fit decision live in the unified budget
+# API (analysis/memory.py) — this module keeps thin aliases.
 
 
 def _paged_vmem_bytes(page_size: int, D: int, g: int, kv_itemsize: int, q_itemsize: int) -> int:
-    """Estimated per-program VMEM working set: double-buffered k/v page
-    blocks, the q group block, and the f32 accumulator/output tiles."""
-    kv = 2 * (2 * page_size * D * kv_itemsize)  # k + v, double-buffered DMA
-    qb = g * D * q_itemsize
-    acc = g * D * 4 + 2 * g * 4  # f32 acc + m/l scratch
-    out = g * D * q_itemsize
-    return kv + qb + acc + out
+    from ..analysis import budget as _budget
+
+    return _budget.paged_decode_vmem_bytes(page_size, D, g, kv_itemsize, q_itemsize)
 
 
 def _paged_attn_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
@@ -1665,9 +1662,12 @@ def paged_attention_supported(q, k_pages, v_pages, page_table, seq_lens, scale=N
     )
     if not shapes_ok:
         return False
+    from ..analysis import budget as _budget
+
     kv_item = jnp.dtype(str(k_pages.dtype).rpartition(".")[2]).itemsize
     q_item = jnp.dtype(str(q.dtype).rpartition(".")[2]).itemsize
-    return _paged_vmem_bytes(ps, D, H // Hkv, kv_item, q_item) <= _PAGED_VMEM_LIMIT
+    return _budget.within_vmem(_paged_vmem_bytes(ps, D, H // Hkv, kv_item, q_item),
+                               _budget.paged_vmem_limit())
 
 
 def _paged_attention_impl(q, k_pages, v_pages, page_table, seq_lens, scale=None):

@@ -9,6 +9,7 @@ from __future__ import annotations
 import time
 from typing import Sequence
 
+from ..analysis import manager as _an
 from ..core.prims import PrimIDs
 from ..core.symbol import BoundSymbol, OpTags
 from ..core.trace import TraceCtx, from_trace, tracectx
@@ -19,7 +20,8 @@ from ..observability import metrics as _obs_metrics
 _STRUCTURAL = (PrimIDs.RETURN, PrimIDs.DEL, PrimIDs.COMMENT, PrimIDs.UNPACK_TRIVIAL)
 
 
-def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> TraceCtx:
+def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor],
+                            *, check_traces: bool = False) -> TraceCtx:
     start = time.perf_counter()
     executors = list(executors)
     for al in get_always_executors():
@@ -85,15 +87,24 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     claimed.set_provenance(
         f"Transform for execution (took {(time.perf_counter()-start)*1000:.2f} ms)"
     )
+    # pass-interposed verification (TT_CHECK_TRACES=1 / debug_options): the
+    # claim pass and every fusion pass verify their output, so a violation
+    # is attributed to the exact pass that introduced it
+    where = trace.name_of_fn()
+    _an.checkpoint("executor:claim", claimed, before=trace, where=where,
+                   force=check_traces)
 
     for ex in executors:
         if isinstance(ex, FusionExecutor) or ex.is_fusion_executor():
             with _obs.span(f"fusion:{ex.name}") as sp:
+                pre_fusion = claimed
                 claimed = ex.fusion_pass(claimed)
                 regions = [b for b in claimed.bound_symbols if b.sym.executor is ex]
                 sp.set(regions=len(regions))
             _obs_metrics.record_fusion(ex.name, len(regions),
                                        sum(len(b.subsymbols) for b in regions))
+            _an.checkpoint(f"executor:fusion:{ex.name}", claimed,
+                           before=pre_fusion, where=where, force=check_traces)
 
     # region-name <-> symbol registry: every fusion region formed above is
     # registered (name -> member bsym ids + flops/bytes cost) so device
@@ -106,4 +117,7 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     # regions don't need it but the DELs between them are harmless
     from ..core.transform_common import del_last_used
 
-    return del_last_used(claimed)
+    final = del_last_used(claimed)
+    _an.checkpoint("executor:del_last_used", final, before=claimed, where=where,
+                   force=check_traces)
+    return final

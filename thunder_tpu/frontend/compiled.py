@@ -109,6 +109,9 @@ class InterpretedFunction:
         self.cache_option = cache
         self.disable_fusion = disable_fusion
         dbg = compile_options.pop("debug_options", None)
+        # per-function pass-interposed trace checking (analysis/manager.py);
+        # TT_CHECK_TRACES covers every function without the option
+        self._check_traces = bool(dbg is not None and getattr(dbg, "check_traces", False))
         self.record_interpreter_log = bool(
             compile_options.pop("record_interpreter_log", False)
             or (dbg is not None and (getattr(dbg, "show_interpreter_log", False)
@@ -163,8 +166,11 @@ class InterpretedFunction:
         return tuple(key)
 
     def _compile(self, args, kwargs, shape_key) -> InterpretedEntry:
+        from ..analysis import manager as _an
         from ..executors.passes import transform_for_execution
         from ..extend import resolve_executors
+
+        chk = self._check_traces
 
         cs = self._cs
         key_digest = _key_digest(shape_key)
@@ -188,31 +194,48 @@ class InterpretedFunction:
 
             t1 = time.perf_counter_ns()
             pro, trc = res.prologue_trc, res.computation_trc
+            _an.checkpoint("acquisition", trc, where=self.__name__, force=chk)
+            _an.checkpoint("acquisition:prologue", pro, where=self.__name__, force=chk)
             traces = [trc]
             for tf in self.transforms:
                 with _obs.span(f"transform:{type(tf).__name__}") as sp:
+                    prev, prev_pro = trc, pro
                     pro, trc = tf.transform_traces_pre_autodiff(pro, trc, compile_data=None)
                     sp.set(bsyms=len(trc.bound_symbols))
                 phases.append(sp)
                 traces.append(trc)
+                _an.checkpoint(f"transform:{type(tf).__name__}", trc, before=prev,
+                               where=self.__name__, force=chk)
+                if pro is not prev_pro:
+                    # a rewritten prologue is verified too (see the driver in
+                    # thunder_tpu/__init__.py) — prologue corruption must
+                    # blame its pass, not fail guards at dispatch
+                    _an.checkpoint(f"transform:{type(tf).__name__}:prologue", pro,
+                                   where=self.__name__, force=chk)
             with _obs.span("transform:dce") as sp:
+                prev = trc
                 trc = dce(trc)
                 sp.set(bsyms=len(trc.bound_symbols))
             phases.append(sp)
             traces.append(trc)
+            _an.checkpoint("transform:dce", trc, before=prev, where=self.__name__,
+                           force=chk)
             executors = resolve_executors(self.executors or None)
             if self.disable_fusion:
                 executors = [e for e in executors if not e.is_fusion_executor()]
             with _obs.span("executor_dispatch", executors=[e.name for e in executors]) as sp:
-                ex_trc = transform_for_execution(trc, executors)
+                ex_trc = transform_for_execution(trc, executors, check_traces=chk)
                 sp.set(bsyms=len(ex_trc.bound_symbols))
             phases.append(sp)
             traces.append(ex_trc)
             for tf in self.transforms:
                 with _obs.span(f"transform_post:{type(tf).__name__}") as sp:
+                    prev = ex_trc
                     ex_trc = tf.transform_trace_post_optimization(ex_trc, compile_data=None)
                 phases.append(sp)
                 traces.append(ex_trc)
+                _an.checkpoint(f"transform_post:{type(tf).__name__}", ex_trc,
+                               before=prev, where=self.__name__, force=chk)
             cs.last_trace_transform_time_ns = time.perf_counter_ns() - t1
 
             t2 = time.perf_counter_ns()

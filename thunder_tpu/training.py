@@ -252,8 +252,14 @@ class TrainStep:
 
         # argnums=0: the trainable params dict is arg 0 of the traced wrapper;
         # inside the jitted step params are raw arrays, so positional marking
-        # is required
-        vag = ThunderValueAndGrad(traced_split, argnums=0, transforms=self.tmodule._cfn._transforms)
+        # is required. donated_argnums mirrors the jax.jit donation of the
+        # whole step (params donated when self.donate) so the trace carries
+        # the annotation the alias analysis verifies under TT_CHECK_TRACES
+        vag = ThunderValueAndGrad(traced_split, argnums=0,
+                                  transforms=self.tmodule._cfn._transforms,
+                                  donated_argnums=(0,) if self.donate else None,
+                                  check_traces=getattr(self.tmodule._cfn,
+                                                       "_check_traces", False))
         vag._effects_consumer_attached = True  # TrainStep consumes pending effects
         return vag
 
@@ -743,7 +749,10 @@ class TrainStep:
             return inner({**frozen_full, **tfull}, args, kwargs)
 
         traced_full.__name__ = f"nosync_{getattr(inner, '__name__', 'step')}"
-        vag = ThunderValueAndGrad(traced_full, argnums=0, transforms=self.tmodule._cfn._transforms)
+        vag = ThunderValueAndGrad(traced_full, argnums=0,
+                                  transforms=self.tmodule._cfn._transforms,
+                                  check_traces=getattr(self.tmodule._cfn,
+                                                       "_check_traces", False))
         vag._effects_consumer_attached = True
         return vag
 

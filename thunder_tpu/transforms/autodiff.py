@@ -1386,11 +1386,22 @@ class ThunderValueAndGrad(EpilogueMixin):
     native there is no runtime autograd tape, so the API is functional."""
 
     def __init__(self, fn: Callable, argnums=None, transforms: Sequence = (),
-                 interpretation: str | None = None):
+                 interpretation: str | None = None, donated_argnums=None,
+                 check_traces: bool = False):
         self.fn = fn
         self.argnums = (argnums,) if isinstance(argnums, int) else (tuple(argnums) if argnums is not None else None)
         self.transforms = list(transforms)
         self.interpretation = interpretation
+        # positional args whose buffers the caller donates at the jax.jit
+        # level (TrainStep donates params/opt state); the acquired trace is
+        # annotated so the alias analysis can verify read-after-donation
+        self.donated_argnums = (
+            (donated_argnums,) if isinstance(donated_argnums, int)
+            else (tuple(donated_argnums) if donated_argnums else ()))
+        # per-function pass-interposed checking (DebugOptions.check_traces
+        # threaded from the owning jit); TT_CHECK_TRACES covers everything
+        # without it
+        self.check_traces = bool(check_traces)
         self._cache: dict = {}
         self._cs = None  # CompileStats of last compile
 
@@ -1417,9 +1428,13 @@ class ThunderValueAndGrad(EpilogueMixin):
         from ..core.transform_common import dce as _dce
         from ..executors.passes import transform_for_execution
 
+        from ..analysis import manager as _an
+
         cs = CompileStats()
         self._cs = cs
         grad_mask = self._grad_mask(args, kwargs)
+        where = getattr(self.fn, "__name__", "value_and_grad")
+        chk = self.check_traces
 
         t0 = _time.perf_counter_ns()
         prologue_fn = None
@@ -1436,18 +1451,56 @@ class ThunderValueAndGrad(EpilogueMixin):
         else:
             trc, treedef, tensor_mask, leaves = acquire_trace(self.fn, args, kwargs, grad_mask=grad_mask)
         cs.last_trace_tracing_time_ns = _time.perf_counter_ns() - t0
+        if self.donated_argnums:
+            # mark the trace-arg proxies backing donated positional args:
+            # every later checkpoint verifies no pass introduces a read of a
+            # donated buffer after the write that consumes it
+            from ..core.pytree import tree_flatten as _tf
+
+            dmask: list = []
+            for i, a in enumerate(args):
+                lv, _ = _tf(a)
+                dmask.extend([i in self.donated_argnums] * len(lv))
+            lv, _ = _tf(kwargs)
+            dmask.extend([False] * len(lv))
+            tensor_dmask = [d for d, t in zip(dmask, tensor_mask) if t]
+            trc.donated = {p.name for p, d in zip(trc.args, tensor_dmask) if d}
+        _an.checkpoint("acquisition", trc, where=where, force=chk)
 
         t1 = _time.perf_counter_ns()
         for tf in self.transforms:
+            prev = trc
             _, trc = tf.transform_traces_pre_autodiff(None, trc, compile_data=None)
+            _an.checkpoint(f"transform:{type(tf).__name__}", trc, before=prev,
+                           where=where, force=chk)
+        prev = trc
         trc = _dce(trc)
+        _an.checkpoint("transform:dce", trc, before=prev, where=where, force=chk)
         fb = forward_and_backward_traces(trc)
         fwd_trc, bwd_trc = fb.forward_trace, fb.backward_trace
+        # the split rebuilds both traces from scratch (not via from_trace);
+        # the donated annotation follows the forward, whose param proxies —
+        # and so their names — survive the tape replay
+        donated = getattr(trc, "donated", None)
+        if donated:
+            fwd_trc.donated = set(donated)
+        # effect order is checked against the differentiated trace (names
+        # survive the tape replay)
+        _an.checkpoint("autodiff:augmented-forward", fwd_trc, before=trc,
+                       where=where, force=chk)
+        _an.checkpoint("autodiff:backward", bwd_trc, where=where, force=chk)
         for tf in self.transforms:
+            prev_f, prev_b = fwd_trc, bwd_trc
             fwd_trc = tf.transform_trace_post_optimization(fwd_trc, compile_data=None)
             bwd_trc = tf.transform_trace_post_optimization(bwd_trc, compile_data=None)
-        fwd_claimed = transform_for_execution(fwd_trc, resolve_executors(None))
-        bwd_claimed = transform_for_execution(bwd_trc, resolve_executors(None))
+            _an.checkpoint(f"transform_post:{type(tf).__name__}:fwd", fwd_trc,
+                           before=prev_f, where=where, force=chk)
+            _an.checkpoint(f"transform_post:{type(tf).__name__}:bwd", bwd_trc,
+                           before=prev_b, where=where, force=chk)
+        fwd_claimed = transform_for_execution(fwd_trc, resolve_executors(None),
+                                              check_traces=chk)
+        bwd_claimed = transform_for_execution(bwd_trc, resolve_executors(None),
+                                              check_traces=chk)
         cs.last_trace_transform_time_ns = _time.perf_counter_ns() - t1
 
         t2 = _time.perf_counter_ns()

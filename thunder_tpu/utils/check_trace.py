@@ -1,119 +1,18 @@
-"""Trace consistency validator.
+"""Trace consistency validator — compatibility shim.
 
-Re-design of reference thunder/dev_utils/check_trace.py:23 plus the
-in-place-into-fusion sanity check (thunder/core/transform_common.py:68).
-Invariants over proxy def-use — every consumed proxy must be an argument or
-produced earlier; names unique; RETURN last and complete; DEL only of live,
-later-unused proxies; metadata (shape/dtype) stable per name; side-effect
-proxies defined. The sanity layer the reference exposes via
-DebugOptions.check_traces."""
+The verifier grew into a full static-analysis framework in
+``thunder_tpu/analysis/`` (pass-interposed checking under
+``TT_CHECK_TRACES=1``, alias/donation safety, live-range memory budgeting,
+shape/dtype re-inference — see docs/analysis.md). This module keeps the
+original import surface: ``check_trace``, ``check_inplace_into_fusion``,
+``CheckedListOfTraces`` and the (now structured) ``TraceCheckError``.
+"""
 from __future__ import annotations
 
-from ..core.prims import PrimIDs
-from ..core.proxies import Proxy, TensorProxy
-from ..core.trace import TraceCtx
-
-
-class TraceCheckError(AssertionError):
-    pass
-
-
-def check_trace(trace: TraceCtx) -> None:
-    defined: set[str] = {p.name for p in trace.args}
-    ever_defined: set[str] = set(defined)
-    produced_at: dict[str, int] = {}
-    meta: dict[str, tuple] = {}
-    deleted_at: dict[str, int] = {}
-    saw_return = False
-
-    def note_meta(p, i):
-        if isinstance(p, TensorProxy):
-            m = (tuple(p.shape), p.dtype)
-            prev = meta.get(p.name)
-            if prev is not None and prev != m:
-                raise TraceCheckError(
-                    f"proxy '{p.name}' changes metadata at bsym {i}: {prev} -> {m}"
-                )
-            meta[p.name] = m
-
-    for p in trace.args:
-        note_meta(p, -1)
-        if not isinstance(p, Proxy):
-            raise TraceCheckError(f"trace arg {p!r} is not a proxy")
-
-    for i, bsym in enumerate(trace.bound_symbols):
-        if saw_return:
-            raise TraceCheckError(f"bsym {i} ({bsym.sym.name}) appears after RETURN")
-        if bsym.sym.id in (PrimIDs.DEL,):
-            for p in bsym.flat_proxy_args():
-                if p.name not in defined:
-                    where = deleted_at.get(p.name)
-                    extra = f" (already deleted at bsym {where})" if where is not None else ""
-                    raise TraceCheckError(f"DEL of undefined proxy {p.name} at bsym {i}{extra}")
-                defined.discard(p.name)
-                deleted_at[p.name] = i
-            continue
-        for p in bsym.flat_proxy_args():
-            if p.name not in defined:
-                if p.name in deleted_at:
-                    raise TraceCheckError(
-                        f"bsym {i} ({bsym.sym.name}) consumes proxy '{p.name}' "
-                        f"deleted at bsym {deleted_at[p.name]} (use-after-free)"
-                    )
-                raise TraceCheckError(
-                    f"bsym {i} ({bsym.sym.name}) consumes undefined proxy '{p.name}'"
-                )
-            note_meta(p, i)
-        for o in bsym.flat_proxy_outs():
-            if o.name in produced_at:
-                raise TraceCheckError(
-                    f"proxy '{o.name}' produced twice (bsyms {produced_at[o.name]} and {i})"
-                )
-            produced_at[o.name] = i
-            defined.add(o.name)
-            ever_defined.add(o.name)
-            note_meta(o, i)
-        if bsym.sym.id == PrimIDs.RETURN:
-            saw_return = True
-
-    if not saw_return and trace.bound_symbols:
-        raise TraceCheckError("trace has no RETURN")
-
-    # side-effect (epilogue) proxies must be defined somewhere in the trace
-    for owner, name, p in getattr(trace, "side_effects", ()):
-        if isinstance(p, Proxy) and p.name not in ever_defined:
-            raise TraceCheckError(
-                f"side effect ({type(owner).__name__}.{name}) references "
-                f"undefined proxy '{p.name}'"
-            )
-
-
-def check_inplace_into_fusion(trace: TraceCtx) -> None:
-    """A fusion region must not consume a tensor that a later
-    copy_with_setitem mutates (reference _inplace_copy_sanity_check,
-    thunder/core/transform_common.py:68) — the fused program would read
-    either value depending on scheduling."""
-    fusion_reads: dict[str, int] = {}
-    for i, bsym in enumerate(trace.bound_symbols):
-        is_fusion = str(getattr(bsym.sym, "module", "")) == "xla" or "fusion" in bsym.sym.name
-        if is_fusion:
-            for p in bsym.flat_proxy_args():
-                fusion_reads.setdefault(p.name, i)
-        if bsym.sym.id == PrimIDs.COPY_WITH_SETITEM or bsym.sym.name == "copy_with_setitem":
-            for p in bsym.flat_proxy_args()[:1]:
-                j = fusion_reads.get(p.name)
-                if j is not None and j < i:
-                    raise TraceCheckError(
-                        f"in-place copy at bsym {i} mutates '{p.name}' consumed "
-                        f"by fusion at bsym {j}"
-                    )
-
-
-class CheckedListOfTraces(list):
-    """List that validates traces as they are appended (reference
-    thunder/__init__.py:467 wraps trace history this way)."""
-
-    def append(self, trace):
-        check_trace(trace)
-        check_inplace_into_fusion(trace)
-        super().append(trace)
+from ..analysis.errors import TraceCheckError  # noqa: F401
+from ..analysis.verifier import (  # noqa: F401
+    CheckedListOfTraces,
+    check_inplace_into_fusion,
+    check_trace,
+    verify_trace,
+)

@@ -1,17 +1,17 @@
-"""Static per-trace memory estimation.
+"""Static per-trace memory estimation — compatibility surface.
 
 Re-design of reference thunder/examine/memory_calculation.py:151
-(get_alloc_memory): walk the trace accounting allocations, aliases and DELs
-to estimate peak live bytes — the planning tool for remat/batch-size choices
-on HBM-limited TPUs."""
+(get_alloc_memory). The estimator itself moved into the unified budget API
+(``thunder_tpu/analysis/memory.py``: live-range sweep with view-alias
+semantics — views cost nothing but keep their source buffer alive, and
+un-DEL'd args are held for the whole trace); this module keeps the
+original entry points as thin delegates, so there is exactly ONE
+peak-memory walker in the tree.
+"""
 from __future__ import annotations
 
-from ..core.prims import PrimIDs
-from ..core.proxies import TensorProxy, variableify
-from ..core.symbol import OpTags
+from ..core.proxies import TensorProxy
 from ..core.trace import TraceCtx
-
-_VIEW_IDS = {PrimIDs.RESHAPE, PrimIDs.TRANSPOSE, PrimIDs.SQUEEZE, PrimIDs.BROADCAST_IN_DIM}
 
 
 def tensor_bytes(t: TensorProxy) -> int:
@@ -19,48 +19,9 @@ def tensor_bytes(t: TensorProxy) -> int:
 
 
 def get_alloc_memory(trace: TraceCtx) -> tuple[int, dict]:
-    """Returns (peak_bytes, {bsym_index: live_bytes_after})."""
-    live: dict = {}
-    peak = 0
-    timeline = {}
+    """Returns (peak_bytes, {bsym_index: live_bytes_during}) via the
+    live-range analysis in analysis/memory.py."""
+    from ..analysis import memory as _mem
 
-    for p in trace.args:
-        if isinstance(p, TensorProxy):
-            live[p.name] = tensor_bytes(p)
-    current = sum(live.values())
-    peak = current
-
-    # last-use index per proxy for implicit frees (XLA frees dead buffers)
-    last_use: dict[str, int] = {}
-    for i, bsym in enumerate(trace.bound_symbols):
-        for p in bsym.flat_proxy_args():
-            last_use[p.name] = i
-    for p in _flat_output(trace):
-        last_use[p.name] = len(trace.bound_symbols)
-
-    for i, bsym in enumerate(trace.bound_symbols):
-        if bsym.sym.id == PrimIDs.DEL:
-            for p in bsym.flat_proxy_args():
-                current -= live.pop(p.name, 0)
-            timeline[i] = current
-            continue
-        alias = bsym.sym.id in _VIEW_IDS
-        for o in bsym.flat_proxy_outs():
-            if isinstance(o, TensorProxy):
-                b = 0 if alias else tensor_bytes(o)
-                live[o.name] = b
-                current += b
-        peak = max(peak, current)
-        # implicit frees
-        for p in list(live):
-            if last_use.get(p, -1) <= i and p not in {a.name for a in trace.args}:
-                current -= live.pop(p)
-        timeline[i] = current
-    return peak, timeline
-
-
-def _flat_output(trace):
-    from ..core.codeutils import flat_proxies
-
-    out = trace.output
-    return flat_proxies(out) if out is not None else []
+    rep = _mem.peak_bytes(trace, with_timeline=True)
+    return rep.peak_bytes, rep.timeline

@@ -162,6 +162,22 @@ def save_reproducer(cfn, path: str) -> str:
     # steps look like before this trace was saved" is post-mortem gold
     if _obs_flight.recorder().records():
         _obs_flight.recorder().dump(path + ".flight.json")
+    # a recent trace-check failure (analysis/manager.py) is attached with the
+    # failing trace: the blamed pass + minimized repro + full trace text is
+    # exactly what a transform-bug report needs. Consumed on attach — a
+    # failure rides into at most one bundle, never a later unrelated one.
+    from ..analysis import manager as _an_manager
+
+    failure = _an_manager.take_last_failure()
+    if failure is not None:
+        with open(path + ".trace_check.txt", "w") as f:
+            f.write(failure.render() + "\n")
+            if failure.trace is not None:
+                f.write("\n# failing trace (full)\n")
+                try:
+                    f.write(failure.trace.python() + "\n")
+                except Exception as e:
+                    f.write(f"# <unprintable: {e}>\n")
     return path
 
 

@@ -14,6 +14,8 @@ Direction vocabulary (keys not listed are informational and never gated):
                      mfu_measured, tflops_per_sec, vs_baseline
   lower is better    ttft_ms_*, tbot_ms_*, compile_time_s,
                      compile_time_warm_s, host_overhead_us, ms_per_token,
+                     mem_peak_estimated (the live-range peak-HBM estimate —
+                     estimator regressions gate like perf regressions),
                      recompiles_steady_state (zero-tolerance: any increase
                      over the committed count is a regression)
 
@@ -43,8 +45,12 @@ HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
                  "baseline_tokens_per_sec")
 LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
 LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
-                "ms_per_token")
+                "ms_per_token", "mem_peak_estimated")
 ZERO_TOLERANCE = ("recompiles_steady_state",)
+# keys bench.py emits unconditionally (best-effort, but ALWAYS attempted):
+# their disappearance from the current artifact means the producer broke —
+# e.g. the live-range estimator raising — and must gate, not silently skip
+REQUIRED_IF_BASELINE = ("mem_peak_estimated",)
 
 
 def load_rows(path: str) -> list[dict]:
@@ -95,6 +101,10 @@ def compare_rows(baseline: dict, current: dict, *, tolerance: float,
             continue
         cur = current.get(key)
         if not isinstance(cur, (int, float)) or isinstance(cur, bool):
+            if key in REQUIRED_IF_BASELINE:
+                out.append({"key": key, "baseline": base, "current": None,
+                            "bound": base, "direction": direction,
+                            "delta": None, "ok": False})
             continue
         if direction == "zero":
             ok = cur <= base
@@ -143,7 +153,8 @@ def run_gate(baseline_rows: list[dict], current_rows: list[dict], *,
             arrow = {"up": ">=", "down": "<=", "zero": "<="}[v["direction"]]
             status = "REGRESSION" if not v["ok"] else ""
             delta = "" if v["delta"] is None else f"  ({v['delta']:+.1%})"
-            lines.append(f"    {v['key']:<28} {v['current']:>12} vs baseline "
+            cur = "MISSING" if v["current"] is None else v["current"]
+            lines.append(f"    {v['key']:<28} {cur:>12} vs baseline "
                          f"{v['baseline']:>12}  (need {arrow} {v['bound']})"
                          f"{delta}  {status}")
     return n_reg, n_checked, lines
