@@ -55,6 +55,11 @@ def pytest_configure(config):
         "analysis: static-analysis test (trace verifier, pass-interposed "
         "checking, alias/donation safety, memory budgeting; filter with "
         "-m analysis / -m 'not analysis')")
+    config.addinivalue_line(
+        "markers",
+        "compile: compile-service test (content-addressed artifact store, "
+        "parallel region compilation, bucketed lowering, warm-start smoke; "
+        "filter with -m compile / -m 'not compile')")
 
 
 def pytest_collection_modifyitems(config, items):

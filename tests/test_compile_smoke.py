@@ -1,0 +1,72 @@
+"""Warm-start smoke: the quickstart reaches its first step from the store.
+
+Runs ``examples/quickstart/pretrain.py`` TWICE in fresh processes against a
+shared ``TT_ARTIFACT_DIR``. The second (warm) run must reach its first
+train step well under the cold compile time, with ``compile_artifact_hit``
+fired and ZERO reason-coded recompile events — the compile-service
+acceptance path (docs/compilation.md), counter-asserted from the warm
+process's observability timeline.
+
+Marked ``slow`` (two subprocess model compiles) + ``compile``: run with
+``pytest -m compile`` or as part of the full (non-tier-1) suite.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.compile, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PRETRAIN = os.path.join(REPO, "examples", "quickstart", "pretrain.py")
+
+# the warm threshold: generous on slow CI hardware, but still a hard bound
+# that a silently-cold second run (full retrace + XLA compile) cannot meet
+WARM_MAX_FRACTION_OF_COLD = 0.5
+
+
+def _run_pretrain(artifact_dir: str, obs_file: str | None = None) -> tuple[float, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["TT_ARTIFACT_DIR"] = artifact_dir
+    if obs_file:
+        env["TT_OBS_FILE"] = obs_file
+    out = subprocess.run(
+        [sys.executable, PRETRAIN, "--steps", "3"], env=env,
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    m = re.search(r"compile\+step0 ([0-9.]+)s", out.stdout)
+    assert m, f"pretrain output missing first-step timing:\n{out.stdout}"
+    return float(m.group(1)), out.stdout
+
+
+def test_quickstart_warm_start_from_shared_store(tmp_path):
+    store = str(tmp_path / "artifacts")
+    obs = str(tmp_path / "warm_timeline.jsonl")
+
+    cold_s, _ = _run_pretrain(store)
+    warm_s, _ = _run_pretrain(store, obs_file=obs)
+
+    assert warm_s <= max(10.0, WARM_MAX_FRACTION_OF_COLD * cold_s), (
+        f"warm first step took {warm_s:.1f}s vs cold {cold_s:.1f}s — the "
+        f"artifact store did not serve the warm start")
+
+    # counter-asserted: the warm process hit the store and never recompiled
+    hits = 0
+    recompiles = []
+    with open(obs) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "event":
+                if rec.get("name") == "compile_artifact_hit":
+                    hits += 1
+                elif rec.get("name") == "recompile":
+                    recompiles.append(rec.get("attrs", {}))
+    assert hits >= 1, "warm run fired no compile_artifact_hit"
+    assert not recompiles, f"warm run recompiled: {recompiles}"

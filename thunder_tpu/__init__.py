@@ -405,6 +405,24 @@ class ThunderCompiledFunction(EpilogueMixin):
 
 
 
+    def prewarm(self, *args, **kwargs) -> bool:
+        """Compile the specialization for these args WITHOUT executing it —
+        the compile service's pre-dispatch entry point. The executor pass
+        hands fusion regions to compile_service/parallel_compile.py, so
+        with the service enabled the regions XLA-compile concurrently (from
+        the artifact store when warm) before any dispatch. Returns True
+        when a new entry was compiled, False when one already matched."""
+        leaves, _ = tree_flatten((args, kwargs))
+        tensor_mask = [_is_tensor_like(l) for l in leaves]
+        key = _cache_key(leaves, tensor_mask)
+        extra = getattr(self._cd.fn, "__cache_extra__", None)
+        if extra is not None:
+            key = key + (extra(),)
+        if key in self._cache:
+            return False
+        self._compile(args, kwargs, key)
+        return True
+
     # -- introspection (reference thunder/__init__.py:944-1106) --
     @property
     def cache_hits(self):
@@ -595,6 +613,6 @@ def __getattr__(name):
 
     if name in ("nn", "optim", "models", "parallel", "training", "inference",
                 "transforms", "utils", "benchmarks", "recipes", "plugins", "frontend",
-                "robustness", "data"):
+                "robustness", "data", "compile_service", "serving"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'thunder_tpu' has no attribute '{name}'")

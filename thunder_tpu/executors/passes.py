@@ -113,6 +113,14 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor],
     from ..observability import profiler as _obs_profiler
 
     _obs_profiler.register_trace_regions(claimed)
+    # region handoff to the compile service: with the service enabled
+    # (TT_PARALLEL_COMPILE=1 or an artifact store configured), independent
+    # regions lower + XLA-compile concurrently NOW — on a worker pool, from
+    # the store when warm — instead of serially at first dispatch
+    # (compile_service/parallel_compile.py; a no-op by default on CPU)
+    from ..compile_service import parallel_compile as _pc
+
+    _pc.maybe_prewarm(claimed, where=where)
     # eager frees for op-by-op execution (reference passes.py:261); fused
     # regions don't need it but the DELs between them are harmless
     from ..core.transform_common import del_last_used

@@ -127,6 +127,34 @@ class XLAFusionExecutor(FusionExecutor):
         first_call = [True]
 
         def impl(*args):
+            # compile_service/parallel_compile.py installs an AOT-compiled
+            # (or store-deserialized) executable here: dispatch uses it
+            # directly — no lazy jit compile — and ANY mismatch (tracer
+            # args under an ambient trace, aval/ABI drift) falls back to
+            # the jfn path permanently; prewarming must never change
+            # semantics, only when the compile happened.
+            pw = impl._prewarmed
+            if pw is not None:
+                try:
+                    # annotate like the steady-state jfn path: a STORE-served
+                    # executable carries the PUBLISHING process's HLO module
+                    # name, so this runtime annotation (and the named_scope
+                    # inside the program) is what keeps device-time
+                    # attribution joined to this process's region registry
+                    if _obs._BUS.enabled:
+                        with _obs_runtime.annotate_call(name):
+                            return pw(*args)
+                    return pw(*args)
+                except Exception as e:
+                    # the fallback is semantics-preserving but NOT free (a
+                    # hidden lazy recompile follows) — record it so a fleet
+                    # whose prewarmed regions silently disengage is
+                    # distinguishable from one that never prewarmed
+                    impl._prewarmed = None
+                    if _obs._BUS.enabled:
+                        _obs.inc("compile.prewarm_fallback")
+                        _obs.event("prewarm_fallback", fusion=name,
+                                   error=type(e).__name__)
             if first_call[0]:
                 # jax.jit compiles lazily: the first dispatch pays jax
                 # trace + StableHLO lowering + XLA backend compile
@@ -141,6 +169,7 @@ class XLAFusionExecutor(FusionExecutor):
         impl.__name__ = name
         impl.jitted = jfn
         impl.subtrace = subtrace
+        impl._prewarmed = None
         bsym = BoundSymbol(fusion_sym, tuple(inputs), {}, tuple(outputs), subsymbols=tuple(region), impl=impl)
         return bsym
 

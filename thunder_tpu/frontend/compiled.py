@@ -329,6 +329,31 @@ class InterpretedFunction:
         flat_inputs = entry.prologue_fn(*tensor_leaves)
         return entry.computation_fn(*flat_inputs)
 
+    def prewarm(self, *args, **kwargs) -> bool:
+        """Compile the specialization for these args WITHOUT executing it —
+        the compile service's pre-dispatch entry point (fusion regions
+        lower/compile in parallel and are served from the artifact store
+        when warm; compile_service/parallel_compile.py). Returns True when
+        a new entry was compiled, False when one already matched."""
+        leaves, treedef = tree_flatten((args, kwargs))
+        mask, _, number_idx = self._leaf_plan(leaves, treedef)
+        shape_key = self._shape_key(leaves, mask)
+        tensor_leaves = [_unwrap_param(leaves[i])
+                         for i, m in enumerate(mask) if m]
+        if self.cache_option == "symbolic values":
+            # symbolic prologues take the runtime numbers after the tensors
+            # (same convention as __call__) — without them every probe would
+            # TypeError and prewarm would compile a duplicate specialization
+            tensor_leaves = tensor_leaves + [leaves[i] for i in number_idx]
+        for entry in self._mru.snapshot(shape_key):
+            try:
+                entry.prologue_fn(*tensor_leaves)
+                return False  # an existing entry already serves these args
+            except Exception:
+                continue
+        self._compile(args, kwargs, shape_key)
+        return True
+
     @property
     def cache_hits(self):
         return int(self._cs.cache_hits)

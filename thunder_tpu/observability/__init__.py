@@ -39,6 +39,7 @@ from .metrics import (  # noqa: F401
     REASON_SHAPE_CHANGE,
     REASON_STALE_KEY,
     cache_stats,
+    record_artifact,
     record_cache,
     record_executable_size,
     record_fusion,

@@ -131,6 +131,19 @@ def record_cache(cache: str, outcome: str, **attrs) -> None:
     events.inc(f"{cache}.{outcome}", **attrs)
 
 
+def record_artifact(outcome: str, **attrs) -> None:
+    """Compile-artifact-store traffic (thunder_tpu/compile_service/store.py):
+    bumps ``artifact.<outcome>`` (outcome in {"hit", "miss", "evict",
+    "publish"}) and records a ``compile_artifact_<outcome>`` timeline event.
+    ``compile_artifact_hit`` is the counter-asserted signal that a fresh
+    process served its first step from the store with zero trace/lowering
+    work (docs/compilation.md)."""
+    if not events.enabled():
+        return
+    events.inc(f"artifact.{outcome}")
+    events.event(f"compile_artifact_{outcome}", **attrs)
+
+
 def record_recompile(reason: str, **attrs) -> None:
     """A compile that a cache could not serve, tagged with why."""
     if not events.enabled():

@@ -9,8 +9,9 @@ Two compiled programs:
 
 * prefill — per prompt-length BUCKET (power-of-two): dense causal attention
   over the padded prompt, page write-out of the prompt's K/V, logits at the
-  true last token. One thunder specialization per bucket; the scheduler's
-  ShapeKeyedMRU keeps steady-state lookups one probe deep.
+  true last token. One thunder specialization per bucket; buckets come from
+  the system-wide BucketLadder (compile_service/buckets.py), which also
+  keeps the steady-state MRU bookkeeping.
 * decode — ONE program for the whole engine: every active sequence
   contributes one token; k/v land in the pool at (page_table[pos//ps],
   pos%ps) via a batched index_put and attention runs over the pages
@@ -20,6 +21,7 @@ Both are pure functional: pools go in, updated pools come out.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -31,11 +33,19 @@ from ..ops import clang, ltorch
 
 def bucket_len(n: int, *, minimum: int, maximum: int) -> int:
     """Next power-of-two >= n, floored at `minimum` (>= page_size so every
-    bucket is page-aligned) and capped at `maximum` (= max_seq)."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return min(b, maximum)
+    bucket is page-aligned) and capped at `maximum` (= max_seq).
+
+    Compat shim: the rounding rule now lives in the system-wide
+    ``compile_service.buckets.BucketLadder`` (one ladder shared by serving
+    prompt buckets, the bucketed TrainStep, and artifact keys)."""
+    return _ladder(minimum, maximum).bucket_for(n)
+
+
+@functools.lru_cache(maxsize=64)
+def _ladder(minimum: int, maximum: int):
+    from ..compile_service.buckets import BucketLadder
+
+    return BucketLadder(minimum, maximum)
 
 
 class PagedGPTRunner:

@@ -7,10 +7,11 @@ The Orca (OSDI '22) iteration-level scheduling loop over the paged KV pool:
   (FIFO; a request is admitted only when the page pool can cover its whole
   lifetime — prompt pages plus worst-case growth — so decode can never hit
   a mid-flight out-of-pages), runs one shape-BUCKETED prefill per admission
-  (prompt padded to the next power-of-two, so the thunder trace cache serves
-  every prompt length from a handful of specializations; per-bucket entries
-  ride a ShapeKeyedMRU — the same cache discipline as the interpreter
-  frontend), then packs ALL active sequences into ONE compiled decode step
+  (prompt padded to the next rung of the system-wide ``BucketLadder`` —
+  compile_service/buckets.py, the SAME ladder the bucketed TrainStep and
+  stored compile artifacts key on, so there is no separate per-engine
+  bucket mechanism and the thunder trace cache serves every prompt length
+  from a handful of specializations), then packs ALL active sequences into ONE compiled decode step
   over the page pool and retires finished sequences, returning their pages
   to the free-list immediately.
 
@@ -36,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..frontend.compiled import ShapeKeyedMRU
+from ..compile_service.buckets import BucketLadder
 from ..observability import events as _obs
 from ..observability import flight_recorder as _obs_flight
 from ..observability import metrics as _obs_metrics
@@ -44,7 +45,7 @@ from ..observability import runtime as _obs_runtime
 from ..observability import telemetry as _obs_tel
 from ..observability.slo import SLOMonitor, SLOPolicy
 from .kv_pages import PagedKVCache
-from .runner import PagedGPTRunner, bucket_len
+from .runner import PagedGPTRunner
 
 _NULL = contextlib.nullcontext()
 
@@ -80,17 +81,6 @@ class _Request:
     tokens: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
     bucket: int = 0
-
-
-@dataclass
-class _BucketEntry:
-    """Per-bucket serving entry tracked by the ShapeKeyedMRU: the bucket's
-    static shapes plus traffic stats (the compiled specializations
-    themselves live in the thunder trace cache, keyed by these shapes)."""
-
-    bucket: int
-    n_prompt_pages: int
-    hits: int = 0
 
 
 def _sample_tokens(logits, seeds, pos, temps):
@@ -138,13 +128,13 @@ class ServingEngine:
         if n_pages is None:
             n_pages = 1 + max_batch * self.n_pages_max
         self.min_bucket = max(page_size, min_bucket or page_size)
-        if self.min_bucket % page_size:
-            # buckets double from min_bucket, so page alignment of every
-            # bucket reduces to alignment of the first — reject a
-            # misconfiguration here instead of surfacing it as an opaque
-            # reshape error inside every prefill trace
-            raise ValueError(f"min_bucket={self.min_bucket} must be a "
-                             f"multiple of page_size={page_size}")
+        # ONE bucket ladder (compile_service/buckets.py) owns the rounding
+        # rule, page-alignment validation, and the per-rung traffic stats
+        # that used to live in a separate ShapeKeyedMRU of _BucketEntry
+        # records — prompt buckets, the bucketed TrainStep, and stored
+        # artifact keys all route through the same object
+        self.ladder = BucketLadder(self.min_bucket, self.max_seq,
+                                   page_size=page_size)
         self.dtype = dtype
 
         self.cache = PagedKVCache(cfg.n_layer, n_pages, page_size,
@@ -152,14 +142,6 @@ class ServingEngine:
         self.runner = PagedGPTRunner(gpt, page_size=page_size)
         self.params = {k: p.data for k, p in gpt.named_parameters()}
         self._sampler = jax.jit(_sample_tokens)
-
-        # bucketed-prefill entries under ONE ordered bucket ("buckets"),
-        # most-recently-served first — the probe-order discipline the
-        # interpreter frontend applies to a bucket of specializations
-        # (ShapeKeyedMRU, reused); _bucket_index gives O(1) lookup so a
-        # steady-state admission never scans the order list to find its entry
-        self.prefill_buckets = ShapeKeyedMRU()
-        self._bucket_index: dict = {}
 
         # host-side packed decode state; pos/toks change every step and are
         # re-uploaded, while seeds/temps/page tables only change at
@@ -306,8 +288,8 @@ class ServingEngine:
             "active": sum(1 for s in self._slots if s is not None),
             "pending": len(self._pending),
             "decode_steps": self.decode_steps,
-            "prefill_buckets": [e.bucket for e in
-                                self.prefill_buckets.snapshot("buckets")],
+            "prefill_buckets": self.ladder.mru(),
+            "bucket_hits": self.ladder.hits(),
         }
         if self.slo_policy is not None:
             out["requests_retired"] = self.requests_retired
@@ -363,7 +345,7 @@ class ServingEngine:
         writes bucket//page_size pages, growth extends to L+max_new tokens.
         Reserving the max at admission means decode can never hit a
         mid-flight out-of-pages (the admission policy; docs/serving.md)."""
-        bucket = bucket_len(L, minimum=self.min_bucket, maximum=self.max_seq)
+        bucket = self.ladder.bucket_for(L)
         return max(bucket // self.page_size,
                    PagedKVCache.pages_for(L + max_new, self.page_size))
 
@@ -414,10 +396,9 @@ class ServingEngine:
     def _prefill(self, req: _Request, slot: int) -> None:
         obs_on = _obs.enabled()
         L = len(req.prompt)
-        bucket = bucket_len(L, minimum=self.min_bucket, maximum=self.max_seq)
+        bucket = self.ladder.touch(L)
         req.bucket = bucket
         n_prompt_pages = bucket // self.page_size
-        self._touch_bucket(bucket, n_prompt_pages)
         idx = np.zeros((1, bucket), np.int32)
         idx[0, :L] = req.prompt
         page_ids = jnp.asarray(req.pages[:n_prompt_pages], jnp.int32)
@@ -461,21 +442,6 @@ class ServingEngine:
         self._seeds[slot] = req.seed
         self._temps[slot] = req.temperature
         self._pt_dirty = True
-
-    def _touch_bucket(self, bucket: int, n_prompt_pages: int) -> None:
-        """ShapeKeyedMRU bookkeeping: the bucket just served moves to the
-        front of the probe order (mirrors the interpreter frontend's
-        steady-state discipline; stats() exposes the MRU order). The side
-        index makes the entry lookup O(1) — no order-list scan per
-        admission."""
-        entry = self._bucket_index.get(bucket)
-        if entry is not None:
-            entry.hits += 1
-            self.prefill_buckets.promote("buckets", entry)
-            return
-        entry = _BucketEntry(bucket, n_prompt_pages, hits=1)
-        self._bucket_index[bucket] = entry
-        self.prefill_buckets.insert("buckets", entry)
 
     def _clear_slot(self, i: int) -> None:
         self._slots[i] = None

@@ -119,7 +119,8 @@ class TrainStep:
     """
 
     def __init__(self, loss_module, optimizer, *, donate: bool = True, mesh_plan=None,
-                 guard=None, slo=None):
+                 guard=None, slo=None, buckets=None, bucket_pad=None,
+                 bucket_axis: int = 1):
         from . import jit as _jit
 
         if isinstance(loss_module, Module):
@@ -151,6 +152,16 @@ class TrainStep:
                     "tokens_per_step=<batch tokens per step> to compute "
                     "throughput")
             self.slo_monitor = SLOMonitor(slo, source="training")
+        # bucketed lowering (compile_service/buckets.py): with a BucketLadder
+        # attached, batch args pad along `bucket_axis` to the next rung
+        # before dispatch, so every length in a bucket shares ONE compiled
+        # (and one stored) artifact — the trainer-side collapse of the
+        # serving engine's prompt buckets. bucket_pad maps positional index
+        # (or kwarg name) -> fill value; causal-LM targets use -100 so
+        # ltorch.cross_entropy masks padded positions out of loss AND grads.
+        self.buckets = buckets
+        self.bucket_pad = dict(bucket_pad or {})
+        self.bucket_axis = bucket_axis
         self._jitted: Optional[Callable] = None
         self.opt_state = None
         self._step_count = 0
@@ -364,6 +375,10 @@ class TrainStep:
             # outputs): a guarded and an unguarded step must never share an
             # AOT entry
             self._guard.program_key() if self._guard is not None else "noguard",
+            # a bucketed step's artifact serves a LENGTH RANGE: the ladder
+            # identity keys it so a different ladder (different rungs, so
+            # different padded shapes could coincide) never shares an entry
+            self.buckets.key_fields() if self.buckets is not None else "nobuckets",
             "|".join(_safe_repr(t) for t in getattr(self.tmodule._cfn, "_transforms", ())),
         ])
         inputs = (tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
@@ -425,6 +440,28 @@ class TrainStep:
         # does not populate jax.jit's dispatch cache; without this the first
         # call would trace the whole step a second time)
         self._jitted = _CompiledWithFallback(compiled, lambda: jit_fn)
+
+    def _bucketize(self, args, kwargs):
+        """Pad batch leaves to the attached BucketLadder's next rung (no-op
+        without a ladder, zero copies when lengths already sit on a rung).
+        Every length in a bucket then dispatches through the SAME cache key
+        — steady-state recompiles across a (batch, seq) sweep stay at zero,
+        and the stored whole-step artifact serves the whole range."""
+        if self.buckets is None:
+            return args, kwargs
+        from .compile_service.buckets import pad_to_bucket
+
+        for a in args:
+            shape = getattr(a, "shape", None)
+            if shape is not None and len(shape) > self.bucket_axis:
+                # ladder traffic stats (MRU order, per-rung hits) — the
+                # same bookkeeping the serving engine records per prefill
+                self.buckets.touch(int(shape[self.bucket_axis]))
+                break
+        args, kwargs = pad_to_bucket(args, kwargs, self.buckets,
+                                     axis=self.bucket_axis,
+                                     pad_values=self.bucket_pad)
+        return args, kwargs
 
     def _split_params(self):
         self._split_walks += 1
@@ -512,6 +549,7 @@ class TrainStep:
         self._sync_mode()
         if getattr(self.tmodule, "_no_sync_active", False):
             return self.micro_step(*args, **kwargs)
+        args, kwargs = self._bucketize(args, kwargs)
         # fault-injection seam (TT_FAULT): with no plan armed this is one
         # module-global read — the same zero-work contract as the bus
         step_idx = self._step_count
@@ -625,6 +663,7 @@ class TrainStep:
                 "accumulation windows yet; step without no_sync, or drop "
                 "the guard")
         self._sync_mode()
+        args, kwargs = self._bucketize(args, kwargs)
         plan = getattr(self.tmodule, "_dist_plan", None)
         if plan is not None:
             return self._micro_step_dist(plan, args, kwargs)

@@ -1,52 +1,45 @@
-"""AOT whole-step executable cache: warm process start in seconds, not
-retrace time.
+"""AOT whole-step executable cache — now a thin compat shim over the
+content-addressed artifact store (thunder_tpu/compile_service/store.py).
 
-The persistent XLA compilation cache (utils/compile_cache.py) only skips the
-XLA *backend* compile; a new process still pays thunder trace acquisition +
-transforms + jax retrace + StableHLO lowering (~40-70 s for the bench
-models). This layer serializes the COMPILED whole-step executable
-(`jax.experimental.serialize_executable`) keyed by everything that could
-change the program — package source digest, jax/jaxlib version, device kind,
-the step's input tree/shape/dtype spec, optimizer config — and on a warm
-start deserializes and runs it directly: no tracing, no lowering, no compile.
+The public surface (``enabled``/``cache_dir``/``step_key``/``module_digest``/
+``load_keyed``/``save_keyed``) and the legacy ``aot.*`` counters are
+unchanged; the storage layer is not:
 
-BASELINE.json's secondary metric (compile_time_warm_s <= 10) is met here.
+* entries live in the store's content-addressed layout (per-key directory,
+  ``manifest.json`` with a sha256 recorded at publish time) and the digest
+  is verified BEFORE any ``pickle`` deserialization — the old flat-file
+  format deserialized unvalidated bytes;
+* legacy flat ``<base>-<digest>.aot`` files are never deserialized: they
+  carry no publish-time digest, so they are swept with a ``stale-key``
+  event (one recompile re-publishes them in the verified format);
+* cross-process concurrency (racing publishes, torn reads, GC) is the
+  store's contract, not this module's.
 
 Controlled by:
-  TT_AOT_CACHE_DIR — cache directory (default ~/.cache/thunder_tpu/aot)
-  TT_NO_AOT_CACHE=1 — disable
-Default-on only on non-CPU backends (CPU executables are machine-specific
-and compile in seconds anyway).
+  TT_ARTIFACT_DIR — the compile service store root (enables on ANY backend)
+  TT_AOT_CACHE_DIR — legacy alias for the same directory
+  TT_NO_AOT_CACHE=1 / TT_NO_ARTIFACT_STORE=1 — disable
+Default-on only on non-CPU backends when no directory is named (CPU
+executables are machine-specific and compile in seconds anyway).
 """
 from __future__ import annotations
 
 import glob
 import hashlib
 import os
-import pickle
-import tempfile
 
+from ..compile_service import store as _cs
 from ..observability import metrics as _obs_metrics
 
 _SRC_DIGEST: str | None = None
 
 
 def enabled() -> bool:
-    if os.environ.get("TT_NO_AOT_CACHE") == "1":
-        return False
-    if os.environ.get("TT_AOT_CACHE_DIR"):
-        return True
-    try:
-        import jax
-
-        return jax.default_backend() != "cpu"
-    except Exception:
-        return False
+    return _cs.store_enabled()
 
 
 def cache_dir() -> str:
-    d = os.environ.get("TT_AOT_CACHE_DIR") or os.path.join(
-        os.path.expanduser("~"), ".cache", "thunder_tpu", "aot")
+    d = _cs.store_dir()
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -128,100 +121,109 @@ def module_digest(module) -> str:
     return h.hexdigest()
 
 
-def _deserialize(path: str):
-    from jax.experimental import serialize_executable as se
+def _store() -> _cs.ArtifactStore:
+    return _cs.get_store(cache_dir())
 
-    with open(path, "rb") as f:
-        payload, in_tree, out_tree = pickle.load(f)
-    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+def _store_key(base_key: str, digest: str) -> str:
+    return _cs.artifact_key(kind="step", base_key=base_key, digest=digest[:16])
+
+
+def _sweep_legacy(base_key: str) -> int:
+    """Evict legacy flat-file entries for ``base_key`` (pre-store format:
+    no publish-time digest, so they are never deserialized — the
+    unvalidated-pickle fix). Returns the number swept."""
+    stale = glob.glob(os.path.join(cache_dir(), f"{base_key}*.aot"))
+    for p in stale:
+        _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="stale-key")
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    return len(stale)
 
 
 def load(key: str):
-    """Deserialize a cached executable; None on miss or any failure."""
-    path = os.path.join(cache_dir(), key + ".aot")
-    if not os.path.exists(path):
-        _obs_metrics.record_cache("aot", "miss", key=key[:12])
-        return None
-    try:
-        loaded = _deserialize(path)
-        _obs_metrics.record_cache("aot", "hit", key=key[:12],
-                                  bytes=os.path.getsize(path))
-        return loaded
-    except Exception:
-        # stale/corrupt/other-machine entry: drop it and rebuild
+    """Deserialize a cached executable; None on miss or any failure.
+
+    Read-only on miss (like the pre-store implementation): the legacy
+    unkeyed probe must never sweep digest-keyed entries sharing the base
+    key — only load_keyed, which knows the expected digest, may evict."""
+    st = _store()
+    k = _store_key(key, "")
+    if st.contains(k):
+        loaded = st.get_executable(k)
+        if loaded is not None:
+            _obs_metrics.record_cache("aot", "hit", key=key[:12])
+            return loaded
+        st.record_miss(k, kind="step")
         _obs_metrics.record_cache("aot", "evict", key=key[:12], why="corrupt")
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
         return None
+    st.record_miss(k, kind="step")
+    _obs_metrics.record_cache("aot", "miss", key=key[:12])
+    return None
 
 
 def load_keyed(base_key: str, digest: str):
     """Lookup keyed by (inputs/config base key, model-code digest).
 
     Returns ``(compiled_or_None, outcome)`` with outcome in:
-      "hit"    — exact entry deserialized
+      "hit"    — exact entry digest-verified and deserialized
       "stale"  — an entry exists for these inputs but under a DIFFERENT
-                 model digest (the forward was edited): evicted, cold trace
+                 model digest (the forward was edited), or only in the
+                 unverifiable legacy format: evicted, cold trace
       "miss"   — nothing cached for these inputs
-      "corrupt"— exact entry failed to deserialize: evicted
+      "corrupt"— exact entry failed verification/deserialization: evicted
     """
-    path = os.path.join(cache_dir(), f"{base_key}-{digest[:16]}.aot")
-    if os.path.exists(path):
-        try:
-            loaded = _deserialize(path)
-            _obs_metrics.record_cache("aot", "hit", key=base_key[:12],
-                                      bytes=os.path.getsize(path))
+    st = _store()
+    key = _store_key(base_key, digest)
+    if st.contains(key):
+        loaded = st.get_executable(key)
+        if loaded is not None:
+            _obs_metrics.record_cache("aot", "hit", key=base_key[:12])
             return loaded, "hit"
-        except Exception:
-            _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="corrupt")
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            return None, "corrupt"
-    # `{base_key}*.aot` also sweeps pre-digest `{base_key}.aot` entries
-    # written by the legacy save(); base keys are fixed-length sha256 hex,
-    # so the prefix cannot match a different key
-    stale = glob.glob(os.path.join(cache_dir(), f"{base_key}*.aot"))
-    if stale:
-        # same inputs/config, different model code: never run it; evict so
-        # the directory doesn't accumulate one entry per edit
-        for p in stale:
-            _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="stale-key")
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
+        # digest mismatch or undeserializable: the store evicted it and a
+        # cold compile follows — a store miss, same as plain absence
+        st.record_miss(key, kind="step")
+        _obs_metrics.record_cache("aot", "evict", key=base_key[:12], why="corrupt")
+        return None, "corrupt"
+    # same inputs/config under a different model digest: never run it; evict
+    # so the store doesn't accumulate one entry per edit
+    n_stale = 0
+    for m in st.find(kind="step", base_key=base_key):
+        if m.get("meta", {}).get("digest") != digest[:16]:
+            st.evict(m["key"], why="stale-key")
+            _obs_metrics.record_cache("aot", "evict", key=base_key[:12],
+                                      why="stale-key")
+            n_stale += 1
+    n_stale += _sweep_legacy(base_key)
+    # either way the store served nothing and a cold compile follows — that
+    # must show in stats()["misses"] (bench's artifact_misses_warm) and as a
+    # compile_artifact_miss event, same as a region-lookup miss
+    st.record_miss(key, kind="step")
+    if n_stale:
         return None, "stale"
     _obs_metrics.record_cache("aot", "miss", key=base_key[:12])
     return None, "miss"
 
 
-def _write(name: str, compiled) -> bool:
-    try:
-        from jax.experimental import serialize_executable as se
-
-        payload, in_tree, out_tree = se.serialize(compiled)
-        d = cache_dir()
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump((payload, in_tree, out_tree), f)
-        final = os.path.join(d, name)
-        os.replace(tmp, final)
-        _obs_metrics.record_executable_size("aot", os.path.getsize(final),
-                                            entry=name[:28])
-        return True
-    except Exception:
-        return False
-
-
 def save(key: str, compiled) -> bool:
-    """Serialize a jax Compiled to the cache (atomic write)."""
-    return _write(key + ".aot", compiled)
+    """Serialize a jax Compiled to the store (atomic publish)."""
+    return save_keyed(key, "", compiled)
 
 
 def save_keyed(base_key: str, digest: str, compiled) -> bool:
     """Digest-keyed save (counterpart of load_keyed)."""
-    return _write(f"{base_key}-{digest[:16]}.aot", compiled)
+    st = _store()
+    key = _store_key(base_key, digest)
+    ok = st.put_executable(key, compiled, kind="step",
+                           meta={"base_key": base_key, "digest": digest[:16]})
+    if ok:
+        # size comes from the manifest (one small json read) — re-reading
+        # and re-hashing a multi-MB payload just to log its size would tax
+        # every compile even with the bus disabled
+        m = st.manifest(key)
+        if m is not None and m.get("bytes") is not None:
+            _obs_metrics.record_executable_size("aot", m["bytes"],
+                                                entry=base_key[:28])
+    return ok

@@ -1,14 +1,18 @@
-"""Persistent XLA compilation cache (TPU-native; no reference counterpart).
+"""Persistent XLA compilation cache — a thin compat shim over the compile
+service (thunder_tpu/compile_service/).
 
 The reference pays its (much smaller) torch.compile cost per process; on TPU
 the whole-step XLA compile is tens of seconds, so thunder_tpu persists
-compiled executables across processes via jax's compilation cache.
-BASELINE.json names compile time the secondary metric — this is how we manage
-it: first process pays the cold compile, every later process (tests, bench
-re-runs, restarts) deserializes from disk.
+compiled executables across processes via jax's compilation cache. This
+layer only skips the XLA *backend* compile; the compile service's artifact
+store (whole-step and region executables) is what removes retrace +
+relowering too — see docs/compilation.md.
 
 Enabled by default at import of thunder_tpu; controlled by:
   TT_COMPILE_CACHE_DIR  — cache directory (default ~/.cache/thunder_tpu/xla)
+  TT_ARTIFACT_DIR       — compile-service store root; the XLA cache rides
+                          under ``<root>/xla`` so ONE directory holds every
+                          compiled artifact (and enables on any backend)
   TT_NO_COMPILE_CACHE=1 — disable entirely
 """
 from __future__ import annotations
@@ -28,6 +32,11 @@ def enable_persistent_cache(cache_dir: str | None = None) -> bool:
         _enabled = False
         return False
     explicit_dir = cache_dir or os.environ.get("TT_COMPILE_CACHE_DIR")
+    if explicit_dir is None and os.environ.get("TT_ARTIFACT_DIR"):
+        # the compile service owns one directory for every compiled
+        # artifact: the XLA backend cache lives in its `xla/` subdir, and
+        # naming TT_ARTIFACT_DIR is an explicit opt-in on any backend
+        explicit_dir = os.path.join(os.environ["TT_ARTIFACT_DIR"], "xla")
     # default-on only for TPU backends: XLA:CPU AOT deserialization warns
     # loudly on machine-feature mismatches, and CPU compiles are cheap anyway.
     # This runs lazily at the first tt.jit compile (not package import), so

@@ -1,0 +1,120 @@
+"""Inspect a thunder_tpu compile-artifact store (compile_service/store.py).
+
+Lists the store's content-addressed entries (kind, size, age, key fields
+from the publish-time manifest), validates each payload against its
+recorded sha256 (the same check the runtime performs before deserializing
+anything), and optionally garbage-collects down to a retention budget.
+The operator-facing answer to "will a fresh replica warm-start from this
+directory?" — mirrors tools/ckpt_inspect.py for checkpoints.
+
+Usage:
+    python tools/cache_inspect.py STORE_DIR                 # list + validate
+    python tools/cache_inspect.py STORE_DIR --kind region   # filter by kind
+    python tools/cache_inspect.py STORE_DIR --gc --keep 32  # GC to last-32
+    python tools/cache_inspect.py STORE_DIR --json          # machine-readable
+
+Exit codes: 0 all listed artifacts valid, 1 at least one invalid,
+2 empty store / unreadable directory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as a plain script from anywhere: the package lives next to tools/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from thunder_tpu.compile_service.store import ArtifactStore  # noqa: E402
+
+
+def _age(created: float | None) -> str:
+    if not created:
+        return "?"
+    s = max(0.0, time.time() - created)
+    if s < 120:
+        return f"{s:.0f}s"
+    if s < 7200:
+        return f"{s / 60:.0f}m"
+    if s < 172800:
+        return f"{s / 3600:.1f}h"
+    return f"{s / 86400:.1f}d"
+
+
+def _meta_summary(m: dict) -> str:
+    meta = m.get("meta", {})
+    parts = [f"{k}={str(v)[:24]}" for k, v in sorted(meta.items())]
+    env = m.get("env", {})
+    if env.get("device_kind"):
+        parts.append(f"device={env['device_kind']}")
+    return " ".join(parts)
+
+
+def inspect_store(directory: str, *, kind: str | None = None, gc: bool = False,
+                  keep: int | None = None, as_json: bool = False) -> int:
+    store = ArtifactStore(directory)
+    if gc:
+        removed = store.gc(keep)
+        print(f"gc: removed {removed} entr{'y' if removed == 1 else 'ies'} "
+              f"(keep={keep if keep is not None else 'TT_ARTIFACT_KEEP'})")
+    entries = store.entries()
+    if kind:
+        entries = [m for m in entries if m.get("kind") == kind or m.get("_invalid")]
+    if not entries:
+        print(f"error: no artifacts found in {directory}", file=sys.stderr)
+        return 2
+    entries.sort(key=lambda m: m.get("_atime", 0.0), reverse=True)
+    any_invalid = False
+    rows = []
+    for m in entries:
+        if m.get("_invalid"):
+            ok, problems = False, ["manifest unreadable"]
+        else:
+            ok, problems = store.validate(m["key"])
+        any_invalid = any_invalid or not ok
+        rows.append((m, ok, problems))
+    if as_json:
+        print(json.dumps([
+            {"key": m.get("key"), "kind": m.get("kind"),
+             "bytes": m.get("bytes"), "created": m.get("created"),
+             "valid": ok, "problems": problems, "meta": m.get("meta", {})}
+            for m, ok, problems in rows], indent=1, sort_keys=True))
+        return 1 if any_invalid else 0
+    print(f"{'key':<14} {'kind':<8} {'status':<8} {'size':>9} {'age':>6}  key fields")
+    total = 0
+    for m, ok, problems in rows:
+        nbytes = m.get("bytes") or 0
+        total += nbytes
+        print(f"{str(m.get('key', '?'))[:12]:<14} {str(m.get('kind', '?')):<8} "
+              f"{'ok' if ok else 'INVALID':<8} {nbytes / 1e6:>7.2f}MB "
+              f"{_age(m.get('created')):>6}  {_meta_summary(m)}")
+        for p in problems:
+            print(f"{'':<14} ! {p}")
+    n_ok = sum(1 for _, ok, _ in rows if ok)
+    print(f"\n{n_ok}/{len(rows)} valid, {total / 1e6:.2f}MB total")
+    return 1 if any_invalid else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="artifact store root (TT_ARTIFACT_DIR)")
+    ap.add_argument("--kind", default=None,
+                    help="only list artifacts of this kind (step/region)")
+    ap.add_argument("--gc", action="store_true",
+                    help="garbage-collect before listing (keep-last-K)")
+    ap.add_argument("--keep", type=int, default=None,
+                    help="retention for --gc (default TT_ARTIFACT_KEEP=64)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ns = ap.parse_args(argv)
+    if not os.path.isdir(ns.directory):
+        print(f"error: {ns.directory} is not a directory", file=sys.stderr)
+        return 2
+    return inspect_store(ns.directory, kind=ns.kind, gc=ns.gc, keep=ns.keep,
+                         as_json=ns.as_json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

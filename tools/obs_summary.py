@@ -198,6 +198,45 @@ def spike_lines(recs: list[dict]) -> list[str]:
     return lines
 
 
+def compile_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """Compile-service section: artifact-store traffic (artifact.* counters
+    + recent compile_artifact_* events) and per-region compile latency from
+    ``compile_region`` spans (parallel region compilation) plus the lazy
+    ``xla_compile`` first-dispatch spans (thunder_tpu/compile_service/)."""
+    art_counters = {k: v for k, v in counters.items() if k.startswith("artifact.")}
+    region_spans = [r for r in recs if r.get("kind") == "span"
+                    and r.get("name") == "compile_region"]
+    lazy_spans = [r for r in recs if r.get("kind") == "span"
+                  and r.get("name") == "xla_compile"]
+    prewarmed = {k: v for k, v in counters.items() if k.startswith("compile.")}
+    if not art_counters and not region_spans and not lazy_spans and not prewarmed:
+        return []
+    lines = []
+    for k, v in sorted({**art_counters, **prewarmed}.items()):
+        lines.append(f"  {k:<28} {v}")
+    evs = [r for r in recs if r.get("kind") == "event"
+           and str(r.get("name", "")).startswith("compile_artifact_")]
+    for r in evs[-6:]:
+        a = r.get("attrs", {})
+        detail = " ".join(f"{k}={v}" for k, v in sorted(a.items()))
+        kind = r["name"].removeprefix("compile_artifact_")
+        lines.append(f"    @{r['ts_ms']:.0f}ms  {kind:<8} {detail}")
+    by_region: dict[str, list] = {}
+    for r in region_spans:
+        by_region.setdefault(r.get("attrs", {}).get("region", "?"), []).append(r)
+    for name, spans in sorted(by_region.items()):
+        durs = sorted(s["dur_ms"] for s in spans)
+        outcomes = sorted({s.get("attrs", {}).get("outcome", "?") for s in spans})
+        lines.append(f"  region {name:<20} n={len(durs)}  "
+                     f"mean={sum(durs) / len(durs):.1f}ms  max={durs[-1]:.1f}ms  "
+                     f"[{','.join(outcomes)}]")
+    if lazy_spans:
+        durs = sorted(s["dur_ms"] for s in lazy_spans)
+        lines.append(f"  lazy xla_compile         n={len(durs)}  "
+                     f"mean={sum(durs) / len(durs):.1f}ms  max={durs[-1]:.1f}ms")
+    return lines
+
+
 def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     """Serving-engine section: serve.* traffic counters plus TTFT/TBOT
     percentiles from serve_retired events and prefill/decode span latency
@@ -329,6 +368,9 @@ def render(recs: list[dict], top: int = 0) -> str:
     rec = recompile_lines(recs, counters)
     if rec:
         out += ["", "== recompiles ==", *rec]
+    comp = compile_lines(recs, counters)
+    if comp:
+        out += ["", "== compile ==", *comp]
     steps = step_stats(recs)
     if steps:
         out += ["", "== step latency (host-side) ==", *steps]
@@ -346,7 +388,8 @@ def render(recs: list[dict], top: int = 0) -> str:
         out += ["", "== slo ==", *slo]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.") and not k.startswith("serve.")
-             and not k.startswith("slo.breach.")
+             and not k.startswith("slo.breach.") and not k.startswith("artifact.")
+             and not k.startswith("compile.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]

@@ -42,15 +42,24 @@ from typing import Optional
 
 HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
                  "mfu", "mfu_measured", "tflops_per_sec", "vs_baseline",
-                 "baseline_tokens_per_sec")
+                 "baseline_tokens_per_sec",
+                 # warm starts must keep being served FROM THE STORE: a hit
+                 # count falling to zero means the compile service silently
+                 # stopped engaging even if wall time still looks ok
+                 "artifact_hits_warm")
 LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
 LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
-                "ms_per_token", "mem_peak_estimated")
+                "ms_per_token", "mem_peak_estimated",
+                # the cold→warm compile ladder (BENCH_COMPILE.json): the
+                # ratio gates robustly across machines whose absolute cold
+                # compile times differ
+                "warm_over_cold")
 ZERO_TOLERANCE = ("recompiles_steady_state",)
-# keys bench.py emits unconditionally (best-effort, but ALWAYS attempted):
-# their disappearance from the current artifact means the producer broke —
-# e.g. the live-range estimator raising — and must gate, not silently skip
-REQUIRED_IF_BASELINE = ("mem_peak_estimated",)
+# keys whose disappearance from the current artifact means the producer
+# broke — the live-range estimator raising, or the artifact store silently
+# disengaging (bench only emits artifact_hits_warm when the store served
+# the warm phase) — and must gate, not silently skip
+REQUIRED_IF_BASELINE = ("mem_peak_estimated", "artifact_hits_warm")
 
 
 def load_rows(path: str) -> list[dict]:
