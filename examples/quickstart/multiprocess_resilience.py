@@ -1,0 +1,134 @@
+"""Quickstart: distributed fault tolerance on a 2-process CPU cluster.
+
+Spawns a REAL 2-process ``jax.distributed`` cluster on this machine (gloo
+CPU collectives — no TPU needed) and demonstrates the robustness layer
+end-to-end under FSDP sharding:
+
+  1. a ``StepGuard`` whose finite gate is a psum'd ALL-HOST verdict: an
+     injected NaN on host 1 only makes BOTH hosts skip that step in
+     lockstep;
+  2. sharded checkpointing: each host writes only its own ``shard-<p>/``
+     blocks, host 0 publishes the merged manifest;
+  3. restart + restore: a fresh cluster resumes from the per-host shards
+     with a bit-identical loss trajectory.
+
+Run:  python examples/quickstart/multiprocess_resilience.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import tempfile  # noqa: E402
+
+from thunder_tpu.parallel.multiprocess import LocalCluster  # noqa: E402
+
+WORKER = """
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+from thunder_tpu.parallel import fsdp, make_mesh
+from thunder_tpu.robustness import CheckpointManager, GuardPolicy, StepGuard
+from thunder_tpu.training import TrainStep
+
+PID = jax.process_index()
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32, seed=1)
+        self.fc2 = nn.Linear(32, 4, seed=2)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc2(ltorch.gelu(self.fc1(x))), y)
+
+
+def batch_for(i):
+    rng = np.random.RandomState(100 + i)
+    return (jnp.asarray(rng.randn(4, 8), jnp.float32),
+            jnp.zeros((4, 4), jnp.float32))
+
+
+guard = StepGuard(GuardPolicy(on_nonfinite="skip", max_consecutive=3))
+step = TrainStep(fsdp(tt.jit(Net()), make_mesh({"fsdp": jax.device_count()})),
+                 optim.AdamW(lr=1e-2), guard=guard)
+mgr = CheckpointManager(os.environ["TT_QS_CKPT"], every_n_steps=4,
+                        async_save=False, preemption=False,
+                        sync_timeout_s=60.0).attach(step)
+phase = os.environ["TT_QS_PHASE"]
+if phase == "train":
+    losses = []
+    for i in range(6):
+        x, y = batch_for(i)
+        losses.append(float(step(x, y)))
+    emit(host=PID, losses=losses, skipped=guard.skipped)
+else:  # resume
+    meta = mgr.restore(step)
+    losses = []
+    for i in range(step.step_count, 6):
+        x, y = batch_for(i)
+        losses.append(float(step(x, y)))
+    emit(host=PID, restored=meta["step"], losses=losses)
+"""
+
+
+def main() -> int:
+    ckdir = tempfile.mkdtemp(prefix="tt_qs_ckpt_")
+    cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+
+    print("== phase 1: 2-process FSDP training, NaN injected on host 1 only ==")
+    train = cluster.run(WORKER, env={"TT_QS_CKPT": ckdir,
+                                     "TT_QS_PHASE": "train",
+                                     "TT_FAULT": "nan_loss@3:host=1"})
+    for r in train:
+        if not r.ok:
+            print(f"host {r.proc} FAILED (rc={r.returncode}):\n{r.stderr[-1200:]}")
+            return 1
+    recs = {rec["host"]: rec for r in train for rec in r.records}
+    for h in sorted(recs):
+        nans = [i for i, l in enumerate(recs[h]["losses"]) if l != l]
+        print(f"  host {h}: skipped={recs[h]['skipped']} nan_steps={nans} "
+              f"losses[:3]={[round(l, 5) for l in recs[h]['losses'][:3]]}")
+    assert recs[0]["skipped"] == recs[1]["skipped"] == 1, "lockstep skip broken"
+    assert recs[0]["losses"] == recs[1]["losses"], "hosts diverged"
+
+    print(f"== phase 2: sharded checkpoint layout under {ckdir} ==")
+    from thunder_tpu.robustness import list_steps, validate_step
+
+    steps = list_steps(ckdir)
+    newest = steps[-1][1]
+    ok, problems = validate_step(newest)
+    print(f"  steps={[s for s, _ in steps]} newest_valid={ok} "
+          f"shards={sorted(n for n in os.listdir(newest) if n.startswith('shard-'))}")
+    assert ok, problems
+
+    print("== phase 3: fresh cluster restores from per-host shards ==")
+    resume = cluster.run(WORKER, env={"TT_QS_CKPT": ckdir,
+                                      "TT_QS_PHASE": "resume"})
+    for r in resume:
+        if not r.ok:
+            print(f"host {r.proc} FAILED (rc={r.returncode}):\n{r.stderr[-1200:]}")
+            return 1
+    rrecs = {rec["host"]: rec for r in resume for rec in r.records}
+    for h in sorted(rrecs):
+        print(f"  host {h}: restored step {rrecs[h]['restored']}, "
+              f"replayed {len(rrecs[h]['losses'])} steps")
+    # the resumed tail must re-walk the original trajectory bit-for-bit
+    restored = rrecs[0]["restored"]
+    want_tail = recs[0]["losses"][restored:]
+    assert rrecs[0]["losses"] == want_tail, (rrecs[0]["losses"], want_tail)
+    assert rrecs[1]["losses"] == want_tail
+    print("ok: lockstep NaN skip + sharded save + bit-identical resume")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,7 +14,16 @@ if not _ONCHIP:
     os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may say "axon" (TPU tunnel)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+        flags = flags + " --xla_force_host_platform_device_count=8"
+    if "xla_backend_optimization_level" not in flags:
+        # tier-1 on CPU is compile-bound (thousands of tiny jits on one
+        # core): backend opt level 1 cuts wall time ~20% with the failure
+        # set byte-identical to the default level. Level 0 is NOT safe —
+        # it breaks cross-program bit-equality (guarded-vs-unguarded step
+        # trajectories). Subprocess tests (quickstarts, the multiprocess
+        # harness) inherit this via os.environ.
+        flags = flags + " --xla_backend_optimization_level=1"
+    os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
@@ -60,6 +69,11 @@ def pytest_configure(config):
         "compile: compile-service test (content-addressed artifact store, "
         "parallel region compilation, bucketed lowering, warm-start smoke; "
         "filter with -m compile / -m 'not compile')")
+    config.addinivalue_line(
+        "markers",
+        "dist: multi-process distributed test (subprocess-spawned 2-process "
+        "CPU cluster via jax.distributed + gloo; these also carry `slow` so "
+        "tier-1 stays fast — run with -m dist)")
 
 
 def pytest_collection_modifyitems(config, items):

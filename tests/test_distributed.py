@@ -120,12 +120,14 @@ def test_ring_attention_matches_sdpa():
         s = jnp.where(mask, s, -jnp.inf)
         return jax.nn.softmax(s, -1) @ v
 
+    from thunder_tpu.training import _shard_map_compat
+
     mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("sp",))
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(_shard_map_compat(
         lambda q, k, v: _ring_attention_impl(q, k, v, axis="sp", causal=True, world_size=4),
-        mesh=mesh,
-        in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
-        out_specs=P(None, None, "sp"), check_vma=False))
+        mesh,
+        (P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
+        P(None, None, "sp")))
     np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref_sdpa(q, k, v)), atol=1e-5)
 
 

@@ -1,0 +1,440 @@
+"""Distributed fault tolerance under a REAL 2-process CPU cluster (ISSUE 14).
+
+Every test here spawns a fresh ``jax.distributed`` + gloo local cluster via
+``parallel.multiprocess.LocalCluster`` — subprocesses, not the in-process
+8-device simulation — so cross-host sharding, the file-based sharded
+checkpoint commit protocol, psum'd guard verdicts, desync detection, and
+host death are exercised the way a TPU fleet would hit them.
+
+The acceptance scenarios (ISSUE 14):
+  (a) kill one host mid-run (injected ``die``), restart the cluster, and
+      ``restore()`` resumes bit-identically from per-host shards;
+  (b) a ``TT_FAULT`` NaN on ONE host makes ALL hosts skip that step in
+      lockstep (psum'd gate; guard.* counters agree across hosts);
+  (c) desync surfaces as a reason-coded DesyncError, not a hung collective.
+
+All tests ride ``slow`` (plus ``dist``) so tier-1 stays fast; run them with
+``pytest -m dist``.
+"""
+import numpy as np
+import pytest
+
+from thunder_tpu.parallel.multiprocess import LocalCluster
+from thunder_tpu.robustness.faults import DIE_EXIT_CODE
+
+pytestmark = [pytest.mark.slow, pytest.mark.dist]
+
+N_STEPS = 8
+CKPT_EVERY = 2
+
+# shared worker preamble: a tiny FSDP-sharded model over the 2-process mesh
+# (fc1/fc2 weights >= 128 numel shard cross-host; biases stay replicated),
+# deterministic per-step batches, and a digest of THIS host's owned shard
+# blocks (comparing run-to-run per host pins bit-identity of sharded state)
+COMMON = """
+import hashlib
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import thunder_tpu as tt
+from thunder_tpu import nn, optim
+from thunder_tpu.ops import ltorch
+from thunder_tpu.parallel import fsdp, make_mesh
+from thunder_tpu.training import TrainStep
+from thunder_tpu.robustness import CheckpointManager, GuardPolicy, StepGuard
+from thunder_tpu.robustness.distributed import snapshot_host_shards
+
+PID = jax.process_index()
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16, seed=1)
+        self.fc2 = nn.Linear(16, 4, seed=2)
+
+    def forward(self, x, y):
+        return ltorch.mse_loss(self.fc2(ltorch.gelu(self.fc1(x))), y)
+
+
+def make_step(guard=None):
+    mesh = make_mesh({"fsdp": jax.device_count()})
+    tm = fsdp(tt.jit(Net()), mesh)
+    return TrainStep(tm, optim.AdamW(lr=1e-2), guard=guard)
+
+
+def batch_for(i):
+    rng = np.random.RandomState(100 + i)
+    return (jnp.asarray(rng.randn(4, 8), jnp.float32),
+            jnp.zeros((4, 4), jnp.float32))
+
+
+def shard_digest(step):
+    params = {k: p.data for k, p in step.tmodule.get_parameters().items()}
+    snap = snapshot_host_shards({"params": params})
+    h = hashlib.sha256()
+    for key in sorted(snap.entries):
+        h.update(key.encode())
+        h.update(np.ascontiguousarray(snap.entries[key]).tobytes())
+    return h.hexdigest()
+"""
+
+
+def _records_by_host(results):
+    out = {}
+    for r in results:
+        for rec in r.records:
+            out.setdefault(rec.get("host", r.proc), []).append(rec)
+    return out
+
+
+def _one(records, host, key):
+    recs = [r for r in records.get(host, ()) if key in r]
+    assert recs, f"host {host} emitted no record with {key!r}"
+    return recs[-1][key]
+
+
+class TestClusterBringup:
+    def test_two_process_mesh_and_psum(self):
+        cluster = LocalCluster(nprocs=2)
+        results = cluster.run(COMMON + """
+x = jnp.ones((4,)) * (PID + 1)
+from jax.sharding import Mesh, PartitionSpec as P
+from thunder_tpu.training import _shard_map_compat
+mesh = Mesh(np.asarray(jax.devices()).reshape(2), ("dp",))
+total = jax.jit(_shard_map_compat(
+    lambda v: jax.lax.psum(jnp.sum(v), "dp"), mesh, (P("dp"),), P()))
+out = float(total(jnp.concatenate([jnp.ones(4) * 1, jnp.ones(4) * 2])))
+emit(host=PID, nprocs=jax.process_count(), ndevices=jax.device_count(),
+     psum=out)
+""")
+        assert all(r.ok for r in results), results
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            assert _one(by_host, h, "nprocs") == 2
+            assert _one(by_host, h, "ndevices") == 2
+            assert _one(by_host, h, "psum") == 12.0  # 4*1 + 4*2
+
+
+class TestShardedCheckpointKillAndResume:
+    """Acceptance (a): reference run, then a run where host 1 DIES mid-step,
+    then a fresh cluster that restores from the per-host shards and finishes
+    with a bit-identical trajectory and forward."""
+
+    REFERENCE = COMMON + """
+step = make_step()
+losses = []
+for i in range(%(n)d):
+    x, y = batch_for(i)
+    losses.append(float(step(x, y)))
+xe, ye = batch_for(999)
+emit(host=PID, losses=losses, fwd=float(step.tmodule(xe, ye)),
+     digest=shard_digest(step))
+""" % {"n": N_STEPS}
+
+    DYING = COMMON + """
+step = make_step()
+mgr = CheckpointManager(os.environ["TT_TEST_CKPT"], every_n_steps=%(every)d,
+                        async_save=False, preemption=False,
+                        sync_timeout_s=30.0).attach(step)
+try:
+    for i in range(%(n)d):
+        x, y = batch_for(i)
+        step(x, y)
+        emit(host=PID, completed=i)
+except BaseException as e:  # the surviving host errors out of the collective
+    emit(host=PID, error=type(e).__name__)
+    raise SystemExit(3)
+""" % {"n": N_STEPS, "every": CKPT_EVERY}
+
+    RESUME = COMMON + """
+step = make_step()
+mgr = CheckpointManager(os.environ["TT_TEST_CKPT"], preemption=False,
+                        sync_timeout_s=30.0).attach(step)
+meta = mgr.restore(step)
+losses = []
+for i in range(step.step_count, %(n)d):
+    x, y = batch_for(i)
+    losses.append(float(step(x, y)))
+xe, ye = batch_for(999)
+emit(host=PID, restored=meta["step"], losses=losses,
+     fwd=float(step.tmodule(xe, ye)), digest=shard_digest(step))
+""" % {"n": N_STEPS}
+
+    def test_kill_one_host_restart_resume_bit_identical(self, tmp_path):
+        ckdir = str(tmp_path / "ckpts")
+        env = {"TT_TEST_CKPT": ckdir}
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+
+        ref = cluster.run(self.REFERENCE, env=env)
+        assert all(r.ok for r in ref), [(r.returncode, r.stderr[-800:]) for r in ref]
+        ref_hosts = _records_by_host(ref)
+        ref_losses = _one(ref_hosts, 0, "losses")
+        assert ref_losses == _one(ref_hosts, 1, "losses")  # replicated loss
+
+        # host 1 dies mid-step 4 (0-based), after the step-4 checkpoint
+        dying = cluster.run(self.DYING,
+                            env={**env, "TT_FAULT": f"die@4:host=1"})
+        assert dying[1].returncode == DIE_EXIT_CODE, (
+            f"host 1 should die by injection, got rc={dying[1].returncode} "
+            f"stderr={dying[1].stderr[-500:]}")
+        assert not dying[0].ok  # the survivor cannot finish without its peer
+        from thunder_tpu.robustness import list_steps, validate_step
+
+        steps = [s for s, _ in list_steps(ckdir)]
+        assert steps and max(steps) == 4, steps
+        ok, problems = validate_step(list_steps(ckdir)[-1][1])
+        assert ok, problems
+
+        # fresh cluster: restore + finish; trajectory/forward/shard digests
+        # must match the uninterrupted reference bit-for-bit
+        resumed = cluster.run(self.RESUME, env=env)
+        assert all(r.ok for r in resumed), [(r.returncode, r.stderr[-800:])
+                                            for r in resumed]
+        res_hosts = _records_by_host(resumed)
+        for h in (0, 1):
+            assert _one(res_hosts, h, "restored") == 4
+            assert _one(res_hosts, h, "losses") == ref_losses[4:]
+            assert _one(res_hosts, h, "fwd") == _one(ref_hosts, h, "fwd")
+            assert _one(res_hosts, h, "digest") == _one(ref_hosts, h, "digest")
+
+
+class TestLockstepGuard:
+    """Acceptance (b): nan_loss on ONE host -> every host skips that step
+    (psum'd verdict), params bit-unchanged on both hosts, guard counters
+    agree across hosts, training continues."""
+
+    WORKER = COMMON + """
+from thunder_tpu import observability
+
+observability.enable()
+guard = StepGuard(GuardPolicy(on_nonfinite="skip", max_consecutive=3))
+step = make_step(guard=guard)
+losses = []
+digests = {}
+for i in range(4):
+    x, y = batch_for(i)
+    if i == 2:
+        digests["before"] = shard_digest(step)
+    losses.append(float(step(x, y)))
+    if i == 2:
+        digests["after"] = shard_digest(step)
+counters = {k: v for k, v in observability.counters().items()
+            if k.startswith("guard.")}
+emit(host=PID, losses=losses, skipped=guard.skipped,
+     consecutive=guard.consecutive_bad, counters=counters,
+     unchanged=digests["before"] == digests["after"],
+     distributed=guard.distributed)
+"""
+
+    def test_one_host_nan_skips_everywhere(self):
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(self.WORKER,
+                              env={"TT_FAULT": "nan_loss@2:host=1"})
+        assert all(r.ok for r in results), [(r.returncode, r.stderr[-800:])
+                                            for r in results]
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            losses = _one(by_host, h, "losses")
+            # step 2's loss is NaN on EVERY host: host 1 poisoned its copy of
+            # the global batch, the psum'd loss carries it everywhere
+            assert np.isnan(losses[2]), (h, losses)
+            assert not any(np.isnan(l) for l in losses[:2] + losses[3:])
+            assert _one(by_host, h, "skipped") == 1
+            assert _one(by_host, h, "consecutive") == 0  # recovered
+            assert _one(by_host, h, "unchanged") is True
+            assert _one(by_host, h, "distributed") is True
+        c0 = _one(by_host, 0, "counters")
+        c1 = _one(by_host, 1, "counters")
+        assert c0 == c1, f"guard counters diverged: {c0} vs {c1}"
+        assert c0.get("guard.nonfinite-skip") == 1
+        assert c0.get("guard.dist_nonfinite-skip") == 1
+
+
+class TestDesyncDetection:
+    def test_mismatched_step_raises_desync_error(self):
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(COMMON + """
+from thunder_tpu.robustness import DesyncError, check_in_sync
+
+try:
+    # host 1 believes it is one step ahead — the classic silent divergence.
+    # Detection is timeout-then-scan (tags are deterministic per step), so
+    # keep the window short.
+    check_in_sync(3 + PID, key="prog", timeout_s=6.0)
+    emit(host=PID, outcome="agreed")
+except DesyncError as e:
+    emit(host=PID, outcome="desync", hosts=e.hosts)
+""")
+        assert all(r.ok for r in results), [(r.returncode, r.stderr[-800:])
+                                            for r in results]
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            assert _one(by_host, h, "outcome") == "desync"
+        # each host's error names the PEER's divergent publication
+        assert _one(by_host, 0, "hosts") == {"1": "4:prog"}
+        assert _one(by_host, 1, "hosts") == {"0": "3:prog"}
+
+    def test_dead_peer_times_out_as_desync(self):
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(COMMON + """
+from thunder_tpu.robustness import DesyncError, check_in_sync
+
+if PID == 1:
+    emit(host=PID, outcome="silent")  # never checks in
+else:
+    try:
+        check_in_sync(3, timeout_s=5.0)
+        emit(host=PID, outcome="agreed")
+    except DesyncError:
+        emit(host=PID, outcome="desync-timeout")
+""")
+        by_host = _records_by_host(results)
+        assert _one(by_host, 0, "outcome") == "desync-timeout"
+
+
+class TestCrossHostShardErrors:
+    def test_rank0_only_save_refuses_cross_host_shards(self, tmp_path):
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(COMMON + """
+from thunder_tpu.parallel import checkpoint as dist_ckpt
+
+step = make_step()
+x, y = batch_for(0)
+step(x, y)
+params = {k: p.data for k, p in step.tmodule.get_parameters().items()}
+assert any(dist_ckpt.is_cross_host(v) for v in params.values())
+try:
+    dist_ckpt.save(params, os.environ["TT_TEST_CKPT"],
+                   options=dist_ckpt.StateDictOptions(rank0_only=True))
+    emit(host=PID, outcome="saved")
+except ValueError as e:
+    emit(host=PID, outcome="refused", match="sharded across hosts" in str(e))
+""", env={"TT_TEST_CKPT": str(tmp_path / "r0")})
+        assert all(r.ok for r in results), [(r.returncode, r.stderr[-800:])
+                                            for r in results]
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            assert _one(by_host, h, "outcome") == "refused"
+            assert _one(by_host, h, "match") is True
+
+    def test_host_scoped_ckpt_fail_fails_save_everywhere_nonfatally(self, tmp_path):
+        """A checkpoint-write failure on ONE host must fail that save on
+        EVERY host (host 0 times out waiting for the missing shard) without
+        killing training, and the NEXT interval save succeeds."""
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(COMMON + """
+import warnings
+
+step = make_step()
+mgr = CheckpointManager(os.environ["TT_TEST_CKPT"], every_n_steps=2,
+                        async_save=False, preemption=False,
+                        sync_timeout_s=8.0).attach(step)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    for i in range(4):
+        x, y = batch_for(i)
+        step(x, y)
+emit(host=PID, saves=mgr.saves, failed=mgr.failed_saves,
+     step_count=step.step_count)
+""", env={"TT_TEST_CKPT": str(tmp_path / "ck"),
+          "TT_FAULT": "ckpt_fail@2:host=1"})
+        assert all(r.ok for r in results), [(r.returncode, r.stderr[-800:])
+                                            for r in results]
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            assert _one(by_host, h, "step_count") == 4  # training survived
+            assert _one(by_host, h, "failed") == 1      # step-2 save failed
+            assert _one(by_host, h, "saves") == 1       # step-4 save landed
+        from thunder_tpu.robustness import list_steps, validate_step
+
+        steps = list_steps(str(tmp_path / "ck"))
+        assert [s for s, _ in steps] == [4]
+        ok, problems = validate_step(steps[-1][1])
+        assert ok, problems
+
+
+class TestDistributedPreemption:
+    """Tentpole scenario: SIGTERM-driven drain-and-save under the 2-process
+    mesh — both hosts drain the in-flight step, coordinate ONE sharded
+    blocking save, and raise Preempted with the published checkpoint."""
+
+    WORKER = COMMON + """
+from thunder_tpu.robustness import Preempted
+
+step = make_step()
+mgr = CheckpointManager(os.environ["TT_TEST_CKPT"], every_n_steps=2,
+                        async_save=False, sync_timeout_s=30.0).attach(step)
+try:
+    for i in range(6):
+        x, y = batch_for(i)
+        step(x, y)
+    emit(host=PID, outcome="never-preempted")
+except Preempted as e:
+    emit(host=PID, outcome="preempted", step=e.step,
+         saved=e.checkpoint_path is not None)
+finally:
+    mgr.close()
+"""
+
+    def test_drain_and_save_in_lockstep(self, tmp_path):
+        ckdir = str(tmp_path / "ck")
+        cluster = LocalCluster(nprocs=2, timeout_s=240.0)
+        results = cluster.run(self.WORKER, env={"TT_TEST_CKPT": ckdir,
+                                                "TT_FAULT": "preempt@3"})
+        assert all(r.ok for r in results), [(r.returncode, r.stderr[-800:])
+                                            for r in results]
+        by_host = _records_by_host(results)
+        for h in (0, 1):
+            assert _one(by_host, h, "outcome") == "preempted"
+            assert _one(by_host, h, "step") == 4  # drained the in-flight step
+            assert _one(by_host, h, "saved") is True
+        from thunder_tpu.robustness import list_steps, validate_step
+
+        steps = list_steps(ckdir)
+        assert [s for s, _ in steps] == [2, 4]  # interval save + final drain
+        ok, problems = validate_step(steps[-1][1])
+        assert ok, problems
+
+    # the signaled host hard-exits after Preempted: on a real fleet the
+    # scheduler's SIGKILL lands when the grace window closes, and lingering
+    # in jax's graceful-shutdown barrier (up to 5 min) deadlocks against
+    # peers blocked in dead collectives
+    ONE_HOST_WORKER = WORKER.replace(
+        'emit(host=PID, outcome="preempted", step=e.step,\n'
+        '         saved=e.checkpoint_path is not None)',
+        'emit(host=PID, outcome="preempted", step=e.step,\n'
+        '         saved=e.checkpoint_path is not None)\n'
+        '    import sys as _s; _s.stdout.flush(); os._exit(0)')
+
+    def test_one_host_sigterm_drains_durably(self, tmp_path):
+        """SIGTERM on ONLY host 0: host 0 must drain with a durable sharded
+        checkpoint and the fleet must not corrupt anything. Host 1 either
+        drains too (watcher flag lands between steps — the realistic
+        slow-step case) or is torn down by the runtime's fatal-error
+        handler when the coordination leader exits (this test's fast-step
+        case) and recovers via restart+restore — never a silent hang."""
+        ckdir = str(tmp_path / "ck")
+        cluster = LocalCluster(nprocs=2, timeout_s=120.0)
+        results = cluster.run(self.ONE_HOST_WORKER,
+                              env={"TT_TEST_CKPT": ckdir,
+                                   "TT_FAULT": "preempt@3:host=0"})
+        by_host = _records_by_host(results)
+        assert _one(by_host, 0, "outcome") == "preempted"
+        assert _one(by_host, 0, "saved") is True
+        assert not results[0].timed_out and not results[1].timed_out
+        # host 1: clean drain, or runtime teardown after the leader exited
+        host1_drained = any("outcome" in r for r in by_host.get(1, ()))
+        if host1_drained:
+            assert _one(by_host, 1, "outcome") == "preempted"
+        else:
+            assert results[1].returncode != 0  # torn down, not hung
+        from thunder_tpu.robustness import list_steps, validate_step
+
+        steps = list_steps(ckdir)
+        assert steps, "no restorable checkpoint after one-host preemption"
+        ok, problems = validate_step(steps[-1][1])
+        assert ok, problems

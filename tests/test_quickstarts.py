@@ -41,6 +41,25 @@ def test_quickstart_runs(script):
     assert out.returncode == 0, f"{script} failed:\n{out.stderr[-1500:]}"
 
 
+@pytest.mark.slow
+@pytest.mark.dist
+def test_quickstart_multiprocess_resilience():
+    """The distributed fault-tolerance smoke in the quickstart CI lane: a
+    REAL 2-process gloo cluster (spawned inside the script) demonstrates
+    lockstep NaN skipping, sharded checkpointing, and bit-identical resume.
+    Rides slow+dist so tier-1 stays fast; the quickstart lane runs it with
+    ``pytest -m dist tests/test_quickstarts.py``."""
+    path = os.path.join(QS, "multiprocess_resilience.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run([sys.executable, path], env=env,
+                         capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, (
+        f"multiprocess_resilience.py failed:\n{out.stdout[-800:]}\n"
+        f"{out.stderr[-1200:]}")
+    assert "bit-identical resume" in out.stdout
+
+
 @pytest.mark.analysis
 @pytest.mark.parametrize("script", ["pretrain.py", "continuous_batching.py"])
 def test_quickstart_runs_with_trace_checking(script):

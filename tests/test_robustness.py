@@ -639,3 +639,348 @@ class TestCkptInspect:
     def test_empty_dir_exit_two(self, tmp_path):
         mod = self._mod()
         assert mod.main([str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# distributed fault tolerance (ISSUE 14) — in-process virtual-mesh half;
+# the real 2-process cluster scenarios live in tests/test_multiprocess.py
+# ---------------------------------------------------------------------------
+
+def _make_fsdp_step(guard=None, ndev=4):
+    from thunder_tpu.parallel import fsdp, make_mesh
+
+    net = _Net()
+    mesh = make_mesh({"fsdp": ndev})
+    tm = fsdp(tt.jit(net), mesh)
+    step = TrainStep(tm, optim.AdamW(lr=1e-2), guard=guard)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    y = jnp.zeros((8, 4), jnp.float32)
+    return step, x, y
+
+
+@pytest.mark.fault
+class TestDistributedGuards:
+    """The NotImplementedError at the old training.py:281 is gone: guards
+    work under a mesh plan via a psum'd all-host verdict."""
+
+    def test_fsdp_guard_skip_gates_update_in_lockstep(self, obs_mem):
+        guard = StepGuard(GuardPolicy(on_nonfinite="skip", max_consecutive=3))
+        step, x, y = _make_fsdp_step(guard=guard)
+        l0 = float(step(x, y))
+        assert not np.isnan(l0)
+        assert guard.distributed  # marked by the distributed build
+        before = _params(step)
+        faults.configure("nan_loss@1")
+        assert np.isnan(float(step(x, y)))
+        after = _params(step)
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+        assert guard.skipped == 1 and guard.consecutive_bad == 1
+        # distributed verdicts double-book under the agreement counters
+        assert observability.counters().get("guard.nonfinite-skip") == 1
+        assert observability.counters().get("guard.dist_nonfinite-skip") == 1
+        faults.clear()
+        assert not np.isnan(float(step(x, y)))  # training continues
+        assert guard.consecutive_bad == 0  # the clean step reset the budget
+
+    def test_fsdp_guard_raise_policy(self):
+        guard = StepGuard(GuardPolicy(on_nonfinite="raise"))
+        step, x, y = _make_fsdp_step(guard=guard)
+        step(x, y)
+        faults.configure("nan_loss@1")
+        with pytest.raises(NonFiniteLossError, match="non-finite"):
+            step(x, y)
+
+    def test_distributed_grad_norm_is_the_true_global_norm(self, monkeypatch):
+        """The guard's reported grad norm under FSDP must equal the
+        single-device global norm — per-param sum-of-squares psum'd over
+        exactly the axes each param is sharded on (a blanket psum would
+        overcount replicated grads; a bare local norm understates sharded
+        ones by √shards)."""
+        captured = {}
+        orig = StepGuard.after_step
+
+        def cap(self, ts, loss, m):
+            captured[id(self)] = float(m[1])
+            return orig(self, ts, loss, m)
+
+        monkeypatch.setattr(StepGuard, "after_step", cap)
+        g_ref = StepGuard(GuardPolicy(on_nonfinite="skip"))
+        step_ref, x, y = _make_step(guard=g_ref)
+        step_ref(x, y)
+        g_dist = StepGuard(GuardPolicy(on_nonfinite="skip"))
+        step_dist, xd, yd = _make_fsdp_step(guard=g_dist)
+        step_dist(xd, yd)
+        ref, dist = captured[id(g_ref)], captured[id(g_dist)]
+        # note: the single-host net sees batch (4,8), the fsdp net (8,8) —
+        # grads differ, so compare against a single-host run of the SAME
+        # batch instead of cross-shape
+        g_ref8 = StepGuard(GuardPolicy(on_nonfinite="skip"))
+        step_ref8 = TrainStep(tt.jit(_Net()), optim.AdamW(lr=1e-2), guard=g_ref8)
+        step_ref8(xd, yd)
+        ref8 = captured[id(g_ref8)]
+        np.testing.assert_allclose(dist, ref8, rtol=1e-5)
+
+    def test_gspmd_guard_skip(self):
+        """The compiler-partitioned road guards too: one global program, so
+        the finite flag is inherently the all-host decision."""
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.gspmd import gspmd_step
+        from thunder_tpu.parallel.transforms import DistPlan, ParamStrategy
+
+        net = _Net()
+        tm = tt.jit(net)
+        mesh = make_mesh({"fsdp": 4})
+        plan = DistPlan(mesh, data_axes=("fsdp",))
+        for name, p in tm.get_parameters().items():
+            plan.param_strategies[name] = [
+                ParamStrategy("shard0" if p.data.shape[0] % 4 == 0 else "replicate",
+                              "fsdp")]
+        guard = StepGuard(GuardPolicy(on_nonfinite="skip", max_consecutive=3))
+        step = gspmd_step(tm, optim.AdamW(lr=1e-2), plan, guard=guard)
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(8, 8), jnp.float32)
+        y = jnp.zeros((8, 4), jnp.float32)
+        assert guard.distributed
+        step(x, y)
+        before = _params(step)
+        faults.configure("nan_loss@1")
+        assert np.isnan(float(step(x, y)))
+        after = _params(step)
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+        faults.clear()
+        assert not np.isnan(float(step(x, y)))
+
+
+class TestHostScopedFaults:
+    def test_parse_host_scope(self):
+        plan = faults.FaultPlan.parse("nan_loss@5:host=1, die@3*2:host=0")
+        specs = [(f.kind, f.step, f.count, f.host) for f in plan.faults]
+        assert specs == [("nan_loss", 5, 1, 1), ("die", 3, 2, 0)]
+
+    def test_parse_rejects_bad_scope(self):
+        with pytest.raises(ValueError, match="host"):
+            faults.FaultPlan.parse("nan_loss@5:rank=1")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.FaultPlan.parse("explode@5:host=1")
+
+    def test_host_scoped_fault_fires_only_on_matching_host(self, monkeypatch):
+        monkeypatch.setenv("TT_MP_PROC", "1")
+        faults._reset_host_index()
+        try:
+            plan = faults.FaultPlan.parse("nan_loss@0:host=0,transient@0:host=1")
+            assert not plan.should_fire("nan_loss", 0)   # scoped to host 0
+            assert plan.should_fire("transient", 0)      # scoped to us
+            # the foreign-host fault is never consumed here
+            assert [f.kind for f in plan.pending()] == ["nan_loss"]
+        finally:
+            faults._reset_host_index()
+
+    def test_idle_path_stays_one_global_read(self, monkeypatch):
+        """PR 9's zero-work contract survives the host-scope extension: with
+        no plan armed, stepping consults should_fire exactly zero times."""
+        calls = {"n": 0}
+        orig = faults.FaultPlan.should_fire
+
+        def counting(self, kind, step):
+            calls["n"] += 1
+            return orig(self, kind, step)
+
+        monkeypatch.setattr(faults.FaultPlan, "should_fire", counting)
+        step, x, y = _make_step()
+        for _ in range(3):
+            step(x, y)
+        assert calls["n"] == 0  # _PLAN is None: active() short-circuits all sites
+        # armed but scoped to another host: sites consult the plan, nothing
+        # fires, and the trajectory is untouched
+        monkeypatch.setenv("TT_MP_PROC", "0")
+        faults._reset_host_index()
+        try:
+            faults.configure("nan_loss@0*99:host=7,die@0*99:host=7")
+            assert not np.isnan(float(step(x, y)))
+            assert calls["n"] > 0
+        finally:
+            faults._reset_host_index()
+
+
+@pytest.mark.fault
+class TestPreemptionEscalation:
+    def test_second_sigterm_escalates_without_rechaining(self, tmp_path, obs_mem):
+        import signal as _signal
+
+        chained = {"n": 0}
+
+        def prev_handler(signum, frame):
+            chained["n"] += 1
+
+        old = _signal.signal(_signal.SIGTERM, prev_handler)
+        step, x, y = _make_step()
+        mgr = CheckpointManager(str(tmp_path), async_save=False).attach(step)
+        try:
+            _signal.raise_signal(_signal.SIGTERM)  # drain begins
+            _signal.raise_signal(_signal.SIGTERM)  # impatient scheduler
+            assert mgr._preempt.escalated.is_set()
+            assert chained["n"] == 1  # second signal did NOT re-enter prev
+            with pytest.raises(Preempted, match="escalated"):
+                step(x, y)
+            assert mgr.saves == 1  # immediate blocking save landed
+            evs = _events("guard")
+            assert any(e["attrs"].get("reason") == "preempt-escalated"
+                       for e in evs)
+            assert observability.counters().get("guard.preempt-escalated") == 1
+        finally:
+            mgr.close()
+            _signal.signal(_signal.SIGTERM, old)
+
+    def test_opt_in_sigint_drains_like_sigterm(self, tmp_path):
+        import signal as _signal
+
+        step, x, y = _make_step()
+        mgr = CheckpointManager(str(tmp_path), async_save=False,
+                                signals=(_signal.SIGTERM, _signal.SIGINT)
+                                ).attach(step)
+        old_int = _signal.getsignal(_signal.SIGINT)
+        try:
+            _signal.raise_signal(_signal.SIGINT)
+            with pytest.raises(Preempted):
+                step(x, y)
+            assert mgr.saves == 1
+        finally:
+            mgr.close()
+            _signal.signal(_signal.SIGINT, old_int)
+
+
+class TestShardedCheckpointLayout:
+    """Single-process coverage of the sharded layout machinery (the commit
+    protocol degenerates to host 0 doing everything); the cross-host block
+    paths are pinned by tests/test_multiprocess.py."""
+
+    def _sharded_save(self, tmp_path):
+        step, x, y = _make_step()
+        mgr = CheckpointManager(str(tmp_path), async_save=False,
+                                preemption=False, distributed=True).attach(step)
+        step(x, y)
+        step(x, y)
+        want = _params(step)
+        path = mgr.save(step, block=True)
+        return step, mgr, x, y, want, path
+
+    def test_sharded_layout_and_restore(self, tmp_path):
+        step, mgr, x, y, want, path = self._sharded_save(tmp_path)
+        assert path is not None
+        names = sorted(os.listdir(path))
+        assert "shard-0" in names and "manifest.json" in names
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["format"] == "checkpoint-v2-sharded"
+        assert manifest["hosts"] == 1
+        # every shard file is covered by the merged manifest
+        assert any(rel.startswith("shard-0/") for rel in manifest["files"])
+        ok, problems = validate_step(path)
+        assert ok, problems
+        step(x, y)  # drift
+        meta = mgr.restore(step)
+        assert meta["step"] == 2
+        got = _params(step)
+        for k in want:
+            np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+    def test_async_sharded_save_round_trips(self, tmp_path):
+        step, x, y = _make_step()
+        mgr = CheckpointManager(str(tmp_path), async_save=True,
+                                preemption=False, distributed=True).attach(step)
+        step(x, y)
+        want = _params(step)
+        mgr.save(step)     # background writer runs the commit protocol
+        step(x, y)         # mutate while in flight (host snapshot protects us)
+        mgr.wait()
+        mgr.restore(step)
+        got = _params(step)
+        for k in want:
+            np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+    def test_missing_shard_refuses_restore(self, tmp_path):
+        import shutil
+
+        step, mgr, x, y, want, path = self._sharded_save(tmp_path)
+        shutil.rmtree(os.path.join(path, "shard-0"))
+        ok, problems = validate_step(path)
+        assert not ok
+        with pytest.raises(CheckpointError):
+            mgr.restore(step)
+
+    def test_ckpt_inspect_validates_and_merges_sharded(self, tmp_path, capsys):
+        import importlib.util
+
+        step, mgr, x, y, want, path = self._sharded_save(tmp_path)
+        spec = importlib.util.spec_from_file_location(
+            "ckpt_inspect", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "ckpt_inspect.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main([str(tmp_path)]) == 0
+        assert "shards=1/1" in capsys.readouterr().out
+        # offline merge -> single-host layout that restores with stock paths
+        merged = str(tmp_path / "merged")
+        assert mod.main([str(tmp_path), "--merge", merged]) == 0
+        step2, x2, y2 = _make_step()
+        step2(x2, y2)
+        mgr2 = CheckpointManager(merged, preemption=False, distributed=False)
+        meta = mgr2.restore(step2)
+        assert meta["step"] == 2
+        got = _params(step2)
+        for k in want:
+            np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+        # a deleted host shard flips validate to exit 1 with a named host
+        import shutil
+
+        shutil.rmtree(os.path.join(path, "shard-0"))
+        assert mod.main([str(tmp_path)]) == 1
+        assert "missing host shard: shard-0" in capsys.readouterr().out
+
+    def test_obs_summary_renders_shard_and_desync_sections(self, tmp_path, obs_mem):
+        import importlib.util
+
+        from thunder_tpu.observability import metrics as obs_metrics
+
+        obs_metrics.record_ckpt_shard(0, 4, 1234, step=2)
+        obs_metrics.record_ckpt_shard(1, 3, 999, step=2)
+        obs_metrics.record_desync("mismatch", step=3,
+                                  hosts={"0": "3:k", "1": "4:k"})
+        obs_metrics.record_dist_verdict("nonfinite-skip", step=5)
+        observability.event("checkpoint_save", phase="done", step=2, ms=7.5)
+        shard = str(tmp_path / "t.jsonl")
+        observability.dump(shard)
+        spec = importlib.util.spec_from_file_location(
+            "obs_summary", os.path.join(os.path.dirname(__file__), "..",
+                                        "tools", "obs_summary.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        recs = mod.load_many([shard])
+        out = mod.render(recs)
+        assert "checkpoint / robustness" in out
+        assert "host 0" in out and "host 1" in out
+        assert "bytes=1234" in out
+        assert "DESYNC mismatch" in out
+        assert "guard.dist_nonfinite-skip" in out
+        assert "ckpt_save_ms" in out
+
+
+class TestPerfGateCkptKey:
+    def test_ckpt_save_ms_gates_lower_better(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_gate", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "perf_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        base = [{"metric": "ckpt", "ckpt_save_ms": 100.0}]
+        ok = [{"metric": "ckpt", "ckpt_save_ms": 105.0}]
+        bad = [{"metric": "ckpt", "ckpt_save_ms": 150.0}]
+        n_reg, n_checked, _ = mod.run_gate(base, ok, tolerance=0.1, slack_ms=1.0)
+        assert (n_reg, n_checked) == (0, 1)
+        n_reg, _, lines = mod.run_gate(base, bad, tolerance=0.1, slack_ms=1.0)
+        assert n_reg == 1 and any("REGRESSION" in l for l in lines)

@@ -120,8 +120,16 @@ REASON_CODES = (REASON_CACHE_MISS, REASON_SHAPE_CHANGE, REASON_FALLBACK, REASON_
 #   transient-retry      a transient runtime error was retried with backoff
 #   transient-exhausted  the retry budget ran out; the error propagated
 #   preempt              SIGTERM drained into a final checkpoint
+#   preempt-escalated    a SECOND SIGTERM during the drain window forced an
+#                        immediate blocking save (no courtesy waits)
+#
+# Distributed runs double-book interventions under guard.dist_<reason>
+# (record_dist_verdict) — the lockstep-agreement counters — and add the
+# desync.<kind> family (record_desync) for cross-host divergence caught
+# before a hung collective.
 INTERVENTION_CODES = ("nonfinite-skip", "nonfinite-raise", "rollback",
-                      "transient-retry", "transient-exhausted", "preempt")
+                      "transient-retry", "transient-exhausted", "preempt",
+                      "preempt-escalated")
 
 
 def record_cache(cache: str, outcome: str, **attrs) -> None:
@@ -160,6 +168,44 @@ def record_intervention(reason: str, **attrs) -> None:
         return
     events.inc(f"guard.{reason}")
     events.event("guard", reason=reason, **attrs)
+
+
+def record_dist_verdict(reason: str, **attrs) -> None:
+    """An intervention taken on a psum'd ALL-HOST guard verdict. Emits the
+    regular ``guard.<reason>`` vocabulary (every host acts, so every host
+    counts) PLUS ``guard.dist_<reason>``: diffing per-host counter dumps on
+    the dist_* keys is the lockstep-agreement assertion the multi-process
+    harness pins (a host missing a dist_ count diverged from the fleet)."""
+    if not events.enabled():
+        return
+    events.inc(f"guard.{reason}")
+    events.inc(f"guard.dist_{reason}")
+    events.event("guard", reason=reason, distributed=True, **attrs)
+
+
+def record_desync(kind: str, **attrs) -> None:
+    """A cross-host desynchronization detected (step counter or program key
+    disagreement, or an unresponsive peer) BEFORE it could hang a
+    collective. Counter ``desync.<kind>`` + one ``desync`` timeline event
+    carrying the per-host values; ``robustness/distributed.py`` raises
+    ``DesyncError`` right after recording this."""
+    if not events.enabled():
+        return
+    events.inc(f"desync.{kind}")
+    events.event("desync", kind=kind, **attrs)
+
+
+def record_ckpt_shard(host: int, n_blocks: int, nbytes: int, **attrs) -> None:
+    """One host's checkpoint shard written (distributed sharded save).
+    Counters ``checkpoint.shard_written`` / ``checkpoint.shard_bytes`` plus
+    a per-shard ``checkpoint_shard`` event — tools/obs_summary.py renders
+    these as the per-host shard table."""
+    if not events.enabled():
+        return
+    events.inc("checkpoint.shard_written")
+    events.inc("checkpoint.shard_bytes", int(nbytes))
+    events.event("checkpoint_shard", host=int(host), blocks=int(n_blocks),
+                 bytes=int(nbytes), **attrs)
 
 
 def record_slo_breach(reason: str, **attrs) -> None:

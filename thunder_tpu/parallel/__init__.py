@@ -13,6 +13,6 @@ from .mesh import (
     make_mesh,
     single_device_mesh,
 )
-from . import prims
+from . import multiprocess, prims
 from .gspmd import gspmd_step, shard_constraint
 from .transforms import DDPTransform, DistPlan, FSDPTransform, ParamStrategy, ddp, fsdp

@@ -50,6 +50,27 @@ def _orbax():
         return None
 
 
+def is_cross_host(leaf) -> bool:
+    """True when ``leaf`` is a jax.Array whose shards span processes AND is
+    not fully replicated — i.e. no single host can serialize it alone. The
+    sharded checkpoint layer (robustness/distributed.py) exists for exactly
+    these leaves; ``save(rank0_only=True)`` refuses them."""
+    return (isinstance(leaf, jax.Array)
+            and not leaf.is_fully_addressable
+            and not leaf.is_fully_replicated)
+
+
+def _to_host(x):
+    """Host-materialize one leaf. Fully-replicated cross-process arrays go
+    through a local shard (np.asarray on the parent requires full
+    addressability on some jax versions); genuinely cross-host leaves must
+    have been refused before this point."""
+    if (isinstance(x, jax.Array) and not x.is_fully_addressable
+            and x.is_fully_replicated):
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
 def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None) -> None:
     """Save a (possibly sharded) param/optimizer state dict."""
     options = options or StateDictOptions()
@@ -63,11 +84,13 @@ def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None
         # EVERY rank (before the rank0 early-return) so all hosts fail
         # consistently instead of rank 0 crashing while the rest keep going.
         for leaf in jax.tree_util.tree_leaves(state_dict):
-            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            if is_cross_host(leaf):
                 raise ValueError(
                     "save(rank0_only=True) cannot serialize arrays sharded "
                     "across hosts; gather to a full host state dict first "
-                    "(get_model_state_dict(full_state_dict=True))"
+                    "(get_model_state_dict(full_state_dict=True)), or use "
+                    "CheckpointManager's distributed mode (per-host shards "
+                    "+ merged manifest, robustness/distributed.py)"
                 )
         if not (options.full_state_dict or options.cpu_offload):
             options = StateDictOptions(
@@ -76,34 +99,41 @@ def save(state_dict: dict, path: str, *, options: StateDictOptions | None = None
     if options.rank0_only and jax.process_index() != 0:
         return
     if options.full_state_dict or options.cpu_offload:
-        state_dict = jax.tree_util.tree_map(lambda x: np.asarray(x), state_dict)
+        state_dict = jax.tree_util.tree_map(_to_host, state_dict)
     ocp = _orbax()
     path = os.path.abspath(path)
     if ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(path, state_dict, force=True)
         return
-    os.makedirs(path, exist_ok=True)
     flat, treedef = jax.tree_util.tree_flatten(state_dict)
-    arrays = [np.asarray(x) for x in flat]
-    # np.savez silently degrades extension dtypes (bfloat16, fp8 variants)
-    # to raw void bytes; record the true dtype names so load can view()
-    # them back — a checkpoint that changes dtypes is not a checkpoint. The
-    # manifest rides INSIDE the npz so the write stays single-file atomic
-    # (a sidecar file could pair with the wrong npz across a crashed
-    # overwrite)
+    write_flat_npz(path, [_to_host(x) for x in flat],
+                   treedef_note=str(treedef))
+
+
+def write_flat_npz(path: str, arrays: list, *, treedef_note: str) -> None:
+    """The portable npz fallback layout — the ONE place its format lives
+    (``save()`` above and ``ckpt_inspect --merge`` both write through here;
+    ``load()`` reads it). Positional arrays in flatten order, plus:
+
+    * ``__tt_dtypes__``: np.savez silently degrades extension dtypes
+      (bfloat16, fp8 variants) to raw void bytes; the true dtype names ride
+      INSIDE the npz so load can view() them back — a checkpoint that
+      changes dtypes is not a checkpoint (and a sidecar file could pair
+      with the wrong npz across a crashed overwrite);
+    * ``__tt_treedef__``: a debugging note only — ``load()`` reconstructs
+      structure from ``like``, never from this.
+
+    Written tmp + os.replace (the aot_cache idiom): a crash mid-write must
+    never leave a partial state.npz that a later load would trust."""
+    os.makedirs(path, exist_ok=True)
     dtype_names = np.array(json.dumps([str(a.dtype) for a in arrays]))
-    # tmp + os.replace (the aot_cache idiom): a crash mid-write must never
-    # leave a partial state.npz that a later load would trust. The treedef
-    # (debugging aid: load() reconstructs structure from `like`) rides
-    # inside the npz too — a sidecar written after the replace could pair
-    # with the wrong payload across a crashed overwrite
     final = os.path.join(path, "state.npz")
     tmp = f"{final}.{os.getpid()}.tmp"
     try:
         with open(tmp, "wb") as f:
             np.savez(f, *arrays, __tt_dtypes__=dtype_names,
-                     __tt_treedef__=np.array(str(treedef)))
+                     __tt_treedef__=np.array(treedef_note))
         os.replace(tmp, final)
     except BaseException:
         try:
@@ -118,6 +148,10 @@ def load(path: str, *, like: dict | None = None, options: StateDictOptions | Non
     options = options or StateDictOptions()
     ocp = _orbax()
     path = os.path.abspath(path)
+    if os.path.exists(os.path.join(path, "state.npz")):
+        # the portable npz layout (numpy-fallback save, or an offline
+        # ckpt_inspect --merge): readable regardless of orbax availability
+        ocp = None
     if ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()
         if like is not None:
@@ -223,7 +257,7 @@ def async_save(state_dict: dict, path: str, *,
         return _AsyncHandle(lambda: None)
     # snapshot to host first: the caller may donate/overwrite device buffers
     # on the very next step
-    snap = jax.tree_util.tree_map(lambda x: np.asarray(x), state_dict)
+    snap = jax.tree_util.tree_map(_to_host, state_dict)
     ocp = _orbax()
     if ocp is not None and hasattr(ocp, "AsyncCheckpointer"):
         ckptr = _get_async_checkpointer(ocp)

@@ -120,14 +120,21 @@ class GspmdConstraintTransform(_Transform):
 # ---------------------------------------------------------------------------
 
 
-def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
+def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
     """A TrainStep-compatible step where XLA's SPMD partitioner handles the
     collectives: parameters/optimizer state carry NamedShardings from the
     plan, the batch shards over the data axes, and the loss is the global
-    mean — no explicit collective prims, no shard_map."""
+    mean — no explicit collective prims, no shard_map.
+
+    A ``StepGuard`` works here without any explicit psum: the program is ONE
+    global computation, so ``isfinite`` of the global loss/grad-norm IS the
+    all-host verdict — the partitioner replicates the scalar decision to
+    every device, and the ``where`` gate applies it to every shard."""
     from ..training import TrainStep, _batch_pspec
 
-    step = TrainStep(tmodule, optimizer, donate=donate)
+    step = TrainStep(tmodule, optimizer, donate=donate, guard=guard)
+    if guard is not None:
+        guard.mark_distributed()
     if getattr(step.tmodule, "_dist_plan", None) is not None:
         raise ValueError("gspmd_step and the explicit ddp()/fsdp() road are mutually "
                          "exclusive: pass the plan here, don't install it on the module")
@@ -144,19 +151,38 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
     class _GSPMDStep(TrainStep):
         def _build(self, batch_args, batch_kwargs):
             optimizer = self.optimizer
+            guard = self._guard
+            check_gnorm = guard is not None and guard.policy.check_grad_norm
             # plain inner: no collective prims — GSPMD partitions globally
             vag = TrainStep._make_vag(self, sync_loss=True)
             self._vag = vag
 
             def raw_step(tparams, frozen, opt_state, args, kwargs):
+                from ..optim import global_norm as _global_norm
+
                 loss, grads = vag(tparams, frozen, args, kwargs)
-                new_params, new_state = optimizer.update(tparams, grads[0][0], opt_state)
+                param_grads = grads[0][0]
+                new_params, new_state = optimizer.update(tparams, param_grads, opt_state)
                 if vag.consume_pending_effects():
                     raise NotImplementedError(
                         "buffer mutations (BatchNorm running stats) are not "
                         "supported under gspmd_step yet; freeze the buffers "
                         "(module.eval()) or use the explicit-collectives path")
-                return loss, new_params, new_state, ()
+                if guard is None:
+                    return loss, new_params, new_state, ()
+                # the guard gate on global values: loss and gnorm are global
+                # scalars here, so the finite flag is inherently the all-host
+                # agreement — the SPMD partitioner broadcasts the decision
+                gnorm = (_global_norm(param_grads) if check_gnorm
+                         else jnp.zeros((), jnp.float32))
+                finite = jnp.isfinite(loss)
+                if check_gnorm:
+                    finite = jnp.logical_and(finite, jnp.isfinite(gnorm))
+                new_params = {k: jnp.where(finite, v, tparams[k])
+                              for k, v in new_params.items()}
+                new_state = jax.tree_util.tree_map(
+                    lambda nw, od: jnp.where(finite, nw, od), new_state, opt_state)
+                return loss, new_params, new_state, (), (finite, gnorm)
 
             mesh = plan.mesh
             all_params = dict(self.tmodule.get_parameters())
@@ -173,23 +199,31 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True):
                 lambda l: NamedSharding(mesh, _batch_pspec(plan, l)), batch_args)
             bshard_kwargs = jax.tree_util.tree_map(
                 lambda l: NamedSharding(mesh, _batch_pspec(plan, l)), batch_kwargs)
+            out_shardings = (NamedSharding(mesh, P()), pshard, oshard, ())
+            if guard is not None:
+                out_shardings = out_shardings + (
+                    (NamedSharding(mesh, P()), NamedSharding(mesh, P())),)
             jitted = jax.jit(
                 raw_step,
                 in_shardings=(pshard, fshard, oshard, bshard_args, bshard_kwargs),
                 # pin outputs so updated params keep their declared layout
                 # (otherwise XLA may pick a different sharding and the next
                 # call's in_shardings mismatch)
-                out_shardings=(NamedSharding(mesh, P()), pshard, oshard, ()),
+                out_shardings=out_shardings,
                 donate_argnums=(0, 2) if self.donate else (),
             )
 
             ctx_mesh = _auto_mesh(mesh)
-            _mesh_ctx = getattr(jax.sharding, "use_mesh", None) or jax.sharding.set_mesh
+            # use_mesh (new) -> set_mesh (mid) -> the Mesh object itself as
+            # a context manager (0.4.x global mesh context): all three make
+            # bare-PartitionSpec shard_constraint annotations bind
+            _mesh_ctx = (getattr(jax.sharding, "use_mesh", None)
+                         or getattr(jax.sharding, "set_mesh", None))
 
             def jitted_with_mesh(*a, **kw):
                 # mesh context makes bare-PartitionSpec shard_constraint
                 # annotations inside the traced program bind to this mesh
-                with _mesh_ctx(ctx_mesh):
+                with (_mesh_ctx(ctx_mesh) if _mesh_ctx is not None else ctx_mesh):
                     return jitted(*a, **kw)
 
             self._jitted = jitted_with_mesh

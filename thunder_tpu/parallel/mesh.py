@@ -28,7 +28,10 @@ def make_mesh(axis_sizes: dict[str, int], *, devices=None) -> Mesh:
     """Build a named mesh: make_mesh({'fsdp': 8}) or {'dp':2,'tp':4}.
 
     Axis order follows dict order; put DCN-crossing axes first and
-    ICI-heavy axes (tp/sp) last so they land on contiguous devices."""
+    ICI-heavy axes (tp/sp) last so they land on contiguous devices. In a
+    multi-process (jax.distributed) run, ``jax.devices()`` is the GLOBAL
+    device list ordered by process, so the leading axis is the one that
+    crosses hosts — e.g. ``{'dp': n_hosts, 'fsdp': local}``."""
     if devices is None:
         devices = jax.devices()
     names = tuple(axis_sizes.keys())
@@ -36,6 +39,19 @@ def make_mesh(axis_sizes: dict[str, int], *, devices=None) -> Mesh:
     n = int(np.prod(sizes))
     if n > len(devices):
         raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    if n < len(devices) and jax.process_count() > 1:
+        import warnings
+
+        # a sub-mesh in multi-controller SPMD silently drops some hosts'
+        # devices; every process must STILL drive every computation on it,
+        # and a host whose devices are all excluded owns no shards — an
+        # easy way to hang a fleet. Loud, not fatal: single-host debugging
+        # of a pod-shaped mesh is legitimate.
+        warnings.warn(
+            f"make_mesh uses {n} of {len(devices)} global devices in a "
+            f"{jax.process_count()}-process run; excluded hosts must still "
+            f"call every computation on this mesh or the fleet hangs",
+            stacklevel=2)
     arr = np.asarray(devices[:n]).reshape(sizes)
     return Mesh(arr, names)
 

@@ -5,7 +5,10 @@ checkpoint/resume (``CheckpointManager``), SIGTERM draining
 (``PreemptionHandler`` / ``Preempted``), NaN/rollback/retry step guards
 (``StepGuard`` / ``GuardPolicy``), and a deterministic fault-injection
 harness (``faults``, TT_FAULT env knob) that keeps every recovery path
-covered by tests. See docs/robustness.md for the walkthrough.
+covered by tests. Multi-controller runs get the distributed half
+(``distributed``): per-host sharded checkpoints with a merged manifest,
+psum'd all-host guard verdicts, and desync detection (``DesyncError``)
+instead of hung collectives. See docs/robustness.md for the walkthrough.
 
 Quick start::
 
@@ -31,5 +34,6 @@ from .checkpoint_manager import (  # noqa: F401
     read_meta,
     validate_step,
 )
+from .distributed import DesyncError, check_in_sync  # noqa: F401
 from .guards import GuardPolicy, NonFiniteLossError, StepGuard  # noqa: F401
 from .preemption import Preempted, PreemptionHandler  # noqa: F401

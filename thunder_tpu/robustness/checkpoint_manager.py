@@ -146,7 +146,9 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, every_n_steps: int = 0, keep: int = 3,
                  async_save: bool = True, strict: bool = False,
-                 loader=None, preemption: bool = True):
+                 loader=None, preemption: bool = True, signals=None,
+                 distributed: Optional[bool] = None,
+                 sync_timeout_s: float = 120.0):
         if keep < 1:
             raise ValueError("keep must be >= 1")
         self.directory = os.path.abspath(directory)
@@ -155,9 +157,15 @@ class CheckpointManager:
         self.async_save = async_save
         self.strict = strict
         self.loader = loader
+        # distributed (sharded) mode: None auto-detects per save — a manager
+        # built before jax.distributed initializes still does the right thing
+        self.distributed = distributed
+        self.sync_timeout_s = float(sync_timeout_s)
         self._preempt: Optional[PreemptionHandler] = (
-            PreemptionHandler() if preemption else None)
+            PreemptionHandler(signals=signals) if (preemption and signals is not None)
+            else PreemptionHandler() if preemption else None)
         self._writer: Optional[threading.Thread] = None
+        self._watcher = None  # (thread, stop Event) of the preempt watcher
         self._last_error: Optional[BaseException] = None
         self._lock = threading.Lock()
         # observable outcomes (tests / ckpt_inspect)
@@ -165,13 +173,94 @@ class CheckpointManager:
         self.failed_saves = 0
         os.makedirs(self.directory, exist_ok=True)
 
+    def _is_distributed(self) -> bool:
+        if self.distributed is not None:
+            return bool(self.distributed)
+        from ..parallel import multiprocess as _mp
+
+        return _mp.process_count() > 1
+
     # -- wiring -------------------------------------------------------------
 
     def attach(self, train_step) -> "CheckpointManager":
         train_step._ckpt_manager = self
         if self._preempt is not None:
             self._preempt.install()
+            if self._is_distributed():
+                self._start_preempt_watcher()
         return self
+
+    # -- cross-host preemption propagation ---------------------------------
+    #
+    # Fleet schedulers often SIGTERM a subset of hosts. A host that drains
+    # alone leaves its peers stepping into dead collectives, so: the first
+    # host to notice publishes a KV flag (_finalize_preempt), and every
+    # host's watcher thread (1 s poll against the coordination service — no
+    # device work, no step-loop cost) raises the local preempted flag when
+    # any peer drains. Hosts then drain at their next step boundary; the
+    # final saves are best-effort coordinated (hosts may drain 1-2 steps
+    # apart, in which case the final distributed save times out NON-fatally
+    # on its shortened window and the last interval checkpoint is the
+    # resume point).
+
+    _PREEMPT_KV_PREFIX = "tt_preempt/"
+
+    def _start_preempt_watcher(self) -> None:
+        if self._watcher is not None:
+            return
+        from ..parallel import multiprocess as _mp
+
+        client = _mp.coordinator_client()
+        if client is None:
+            return
+        handler = self._preempt
+        stop = threading.Event()
+
+        def watch():
+            while not stop.wait(1.0):
+                try:
+                    entries = client.key_value_dir_get(self._PREEMPT_KV_PREFIX)
+                except Exception:
+                    continue
+                if entries:
+                    if not handler.preempted.is_set():
+                        _obs.event("preempt_signal", source="peer",
+                                   peer=entries[0][0])
+                    handler.preempted.set()
+                    return
+
+        t = threading.Thread(target=watch, name="tt-preempt-watcher", daemon=True)
+        self._watcher = (t, stop)
+        t.start()
+
+    def _publish_preempt(self, step: int) -> None:
+        from ..parallel import multiprocess as _mp
+
+        client = _mp.coordinator_client()
+        if client is None:
+            return
+        try:
+            client.key_value_set(
+                f"{self._PREEMPT_KV_PREFIX}{_mp.process_index()}", str(step))
+        except Exception:
+            pass
+
+    def _peer_preempted(self) -> bool:
+        """Direct KV read: has ANY host published a preemption? Used on the
+        step-failure path (a step that dies mid-collective while a peer is
+        draining must become a drain, not a crash) — the 1 s watcher poll
+        alone can lose that race on fast step loops."""
+        if not self._is_distributed():
+            return False
+        from ..parallel import multiprocess as _mp
+
+        client = _mp.coordinator_client()
+        if client is None:
+            return False
+        try:
+            return bool(client.key_value_dir_get(self._PREEMPT_KV_PREFIX))
+        except Exception:
+            return False
 
     @property
     def preempted(self) -> bool:
@@ -222,22 +311,51 @@ class CheckpointManager:
     # -- save ---------------------------------------------------------------
 
     def save(self, train_step, *, block: Optional[bool] = None,
-             reason: str = "interval") -> Optional[str]:
+             reason: str = "interval", skip_wait: bool = False) -> Optional[str]:
         """Checkpoint the full training state. Returns the final step-dir path
-        for blocking saves, None for async ones (poll ``wait()``)."""
-        self.wait()  # one in-flight write at a time; surfaces strict errors
+        for blocking saves, None for async ones (poll ``wait()``).
+
+        Distributed mode (auto-detected): a desync check runs FIRST — a host
+        at a different step (or a dead peer) surfaces as ``DesyncError`` on
+        the step-loop thread instead of a shard set that never completes —
+        then every host writes only its own shards and host 0 publishes the
+        merged manifest (see ``_write_sharded``)."""
+        distributed = self._is_distributed()
+        if distributed:
+            from .distributed import check_in_sync
+
+            if self._preempt is not None:
+                # (re)arm the cross-host preempt watcher: attach() may have
+                # run before jax.distributed initialized (the auto-detect
+                # flow), in which case the watcher could not start there
+                self._start_preempt_watcher()
+
+            # the key is deliberately step-only: hosts may reach the same
+            # save for different REASONS (one host saw the SIGTERM, the
+            # interval fired elsewhere) and that is still a healthy fleet
+            check_in_sync(train_step._step_count, key="save",
+                          timeout_s=self.sync_timeout_s)
+        if not skip_wait:
+            self.wait()  # one in-flight write at a time; surfaces strict errors
         step = train_step._step_count
         state, meta = self._collect(train_step)
-        snap = self._snapshot(state)
+        if distributed:
+            from .distributed import snapshot_host_shards
+
+            snap = snapshot_host_shards(state)
+            writer = self._write_sharded
+        else:
+            snap = self._snapshot(state)
+            writer = self._write
         final = os.path.join(self.directory, step_dir_name(step))
         _obs.event("checkpoint_save", phase="start", step=step, reason=reason)
         blocking = (not self.async_save) if block is None else block
         if blocking:
-            self._write(snap, meta, final)
+            writer(snap, meta, final)
             if self.strict:
                 self.wait()  # re-raises the stored write error, if any
             return final if self._last_error is None else None
-        t = threading.Thread(target=self._write, args=(snap, meta, final),
+        t = threading.Thread(target=writer, args=(snap, meta, final),
                              name="tt-ckpt-writer", daemon=True)
         with self._lock:
             self._writer = t
@@ -247,7 +365,11 @@ class CheckpointManager:
     def _write(self, snap: dict, meta: dict, final: str) -> None:
         t0 = time.perf_counter()
         step = meta["step"]
-        tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
+        # thread ident too: an ESCALATED preemption save may legitimately
+        # overlap an in-flight async writer from this same pid at this step
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-{step}-{os.getpid()}-{threading.get_ident()}")
         try:
             if _faults.active():
                 _faults.maybe_raise("ckpt_fail", step)
@@ -295,6 +417,108 @@ class CheckpointManager:
         _obs.inc("checkpoint.saved")
         self._prune()
 
+    # -- distributed (sharded) save ----------------------------------------
+    #
+    # Commit protocol over the shared checkpoint filesystem (no device
+    # collectives, no coordination-service calls from the writer thread):
+    #
+    #   1. every host writes its shard payload into a pid-suffixed tmp dir
+    #      and os.replace()s it to  .pending-<step>-<attempt>/shard-<p>
+    #      (the rename IS the per-host done marker);
+    #   2. host 0 polls until all n_hosts shard dirs are present, writes
+    #      meta.json + the MERGED manifest.json (sha256 of every file in
+    #      every shard), and os.replace()s the pending dir into place —
+    #      the publish is one atomic rename, so a crash anywhere leaves
+    #      either the previous checkpoint or a never-listed pending dir;
+    #   3. hosts != 0 poll for the final dir (a returned blocking save
+    #      means durable on every host).
+    #
+    # <attempt> is the host-lockstep save counter: a FAILED attempt (one
+    # host's injected ckpt_fail, a timeout) abandons its pending dir and the
+    # next attempt uses a fresh name, so stale half-written shard sets are
+    # never mistaken for progress. Host 0 sweeps abandoned pending dirs
+    # after each successful publish.
+
+    def _pending_dir(self, step: int) -> str:
+        attempt = self.saves + self.failed_saves
+        return os.path.join(self.directory, f".pending-{step}-{attempt}")
+
+    def _poll(self, ready, what: str, step: int) -> None:
+        deadline = time.monotonic() + self.sync_timeout_s
+        while not ready():
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"distributed checkpoint at step {step}: timed out after "
+                    f"{self.sync_timeout_s:.0f}s waiting for {what} (a peer "
+                    f"host died or failed its shard write)")
+            time.sleep(0.05)
+
+    def _write_sharded(self, snap, meta: dict, final: str) -> None:
+        from . import distributed as _dist
+
+        t0 = time.perf_counter()
+        step = meta["step"]
+        host, n_hosts = snap.host, snap.n_hosts
+        pending = self._pending_dir(step)
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-{step}-shard{host}-{os.getpid()}-{threading.get_ident()}")
+        try:
+            if _faults.active():
+                _faults.maybe_raise("ckpt_fail", step)
+            shutil.rmtree(tmp, ignore_errors=True)
+            _dist.write_host_shard(snap, tmp)
+            os.makedirs(pending, exist_ok=True)
+            shard_final = os.path.join(pending, f"{_dist.SHARD_PREFIX}{host}")
+            shutil.rmtree(shard_final, ignore_errors=True)
+            os.replace(tmp, shard_final)
+            _obs_metrics.record_ckpt_shard(host, len(snap.entries),
+                                           snap.nbytes, step=step)
+            if host == 0:
+                want = [os.path.join(pending, f"{_dist.SHARD_PREFIX}{p}")
+                        for p in range(n_hosts)]
+                self._poll(lambda: all(os.path.isdir(w) for w in want),
+                           f"{n_hosts} host shard(s)", step)
+                meta = dict(meta, hosts=n_hosts, format=_dist.SHARDED_FORMAT)
+                with open(os.path.join(pending, "meta.json"), "w") as f:
+                    json.dump(meta, f, indent=1, sort_keys=True)
+                manifest = {"step": step, "format": _dist.SHARDED_FORMAT,
+                            "hosts": n_hosts, "files": _manifest_files(pending)}
+                with open(os.path.join(pending, "manifest.json"), "w") as f:
+                    json.dump(manifest, f, indent=1, sort_keys=True)
+                aside = None
+                if os.path.isdir(final):
+                    aside = f"{final}.old-{os.getpid()}"
+                    shutil.rmtree(aside, ignore_errors=True)
+                    os.replace(final, aside)
+                os.replace(pending, final)
+                if aside is not None:
+                    shutil.rmtree(aside, ignore_errors=True)
+            else:
+                self._poll(lambda: os.path.isdir(final) and not os.path.isdir(pending),
+                           "host 0 to publish the merged manifest", step)
+        except BaseException as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            self.failed_saves += 1
+            _obs.event("checkpoint.save_failed", step=step, host=host,
+                       error=f"{type(e).__name__}: {e}"[:300])
+            _obs.inc("checkpoint.save_failed")
+            with self._lock:
+                self._last_error = e
+            if not self.strict:
+                warnings.warn(
+                    f"sharded checkpoint save at step {step} failed on host "
+                    f"{host} (non-fatal): {type(e).__name__}: {e}", stacklevel=2)
+            return
+        self.saves += 1
+        with self._lock:
+            self._last_error = None
+        _obs.event("checkpoint_save", phase="done", step=step, host=host,
+                   ms=round((time.perf_counter() - t0) * 1e3, 3))
+        _obs.inc("checkpoint.saved")
+        if host == 0:
+            self._prune()
+
     def wait(self) -> None:
         """Join any in-flight async write; in strict mode re-raise its error
         on the caller's (step-loop) thread."""
@@ -312,6 +536,11 @@ class CheckpointManager:
 
     def close(self) -> None:
         self.wait()
+        if self._watcher is not None:
+            t, stop = self._watcher
+            stop.set()
+            t.join(timeout=3.0)
+            self._watcher = None
         if self._preempt is not None:
             self._preempt.uninstall()
 
@@ -320,29 +549,71 @@ class CheckpointManager:
         for _, path in steps[:-self.keep]:
             shutil.rmtree(path, ignore_errors=True)
             _obs.inc("checkpoint.pruned")
-        # sweep rename-aside/tmp leftovers from crashed EARLIER processes
-        # (never this pid's: _write cleans its own, and racing a live writer
-        # from a future multi-writer setup would corrupt an in-flight save)
+        # sweep rename-aside/tmp/pending leftovers from crashed or failed
+        # earlier attempts — never this pid's (each _write cleans its own),
+        # and never anything RECENT: in a shared multi-host checkpoint dir a
+        # peer's next save may already have live .tmp-*/.pending-* entries
+        # while this host is still pruning, so only entries older than the
+        # longest legitimate in-flight window are dead for sure
         own = f"-{os.getpid()}"
+        min_age = max(600.0, 4.0 * self.sync_timeout_s)
+        now = time.time()
         for name in os.listdir(self.directory):
-            if (".old-" in name or name.startswith(".tmp-")) and not name.endswith(own):
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
+            foreign_tmp = (".old-" in name or name.startswith(".tmp-")) and own not in name
+            if not foreign_tmp and not name.startswith(".pending-"):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                if now - os.path.getmtime(path) < min_age:
+                    continue
+            except OSError:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
 
     # -- preemption ---------------------------------------------------------
 
     def _finalize_preempt(self, train_step) -> None:
         step = train_step._step_count
+        escalated = (self._preempt is not None
+                     and self._preempt.escalated.is_set())
+        reason = "preempt-escalated" if escalated else "preempt"
         path = None
+        if self._is_distributed():
+            # tell the fleet (watcher threads on every peer) so all hosts
+            # drain instead of stepping into a dead collective
+            self._publish_preempt(step)
+        saved_timeout = self.sync_timeout_s
         try:
-            path = self.save(train_step, block=True, reason="preempt")
+            # the grace window is finite: a final save must not burn the
+            # whole of it waiting for a peer that drained at a different
+            # step — time out fast and leave the last interval checkpoint
+            # as the resume point
+            self.sync_timeout_s = min(saved_timeout, 15.0)
+            # escalated (second SIGTERM in the drain window): the grace
+            # period is nearly gone — skip the courtesy join of any
+            # in-flight async writer and save NOW; the final save's step
+            # dir is distinct from any earlier interval save's, so the
+            # concurrent writer cannot collide with it
+            path = self.save(train_step, block=True, reason=reason,
+                             skip_wait=escalated)
         except BaseException as e:
             warnings.warn(f"final preemption checkpoint failed: {e}", stacklevel=2)
-        _obs.event("preempt_checkpoint", step=step, path=path)
-        _obs_metrics.record_intervention("preempt", step=step,
+        finally:
+            self.sync_timeout_s = saved_timeout
+        if self._is_distributed():
+            # propagation grace: while this process (often the coordination
+            # service leader) is still alive, peers' watcher threads can
+            # observe the KV preempt flag — once we exit, a fast-stepping
+            # peer stuck in a dead collective is torn down by the runtime's
+            # fatal-error handler and recovers via restart+restore instead
+            time.sleep(2.5)
+        _obs.event("preempt_checkpoint", step=step, path=path,
+                   escalated=escalated)
+        _obs_metrics.record_intervention(reason, step=step,
                                          saved=path is not None)
         raise Preempted(
             f"preempted at step {step}"
+            + (" (escalated: repeat signal during drain)" if escalated else "")
             + (f"; checkpoint saved to {path}" if path else "; final checkpoint FAILED"),
             step=step, checkpoint_path=path)
 
@@ -395,7 +666,16 @@ class CheckpointManager:
         else:
             opt_like = {}
         like = {"params": params, "buffers": buffers, "opt_state": opt_like}
-        state = dist_ckpt.load(os.path.join(stepdir, _STATE_SUBDIR), like=like)
+        from . import distributed as _dist
+
+        if _dist.is_sharded_checkpoint(stepdir):
+            # sharded layout: reassemble full global arrays from every
+            # host's shard dir (works on ANY host count — one host restoring
+            # a 4-host checkpoint, or vice versa; _apply re-places each
+            # param onto its live sharding)
+            state = _dist.load_sharded_state(stepdir, like=like)
+        else:
+            state = dist_ckpt.load(os.path.join(stepdir, _STATE_SUBDIR), like=like)
         self._apply(train_step, state, meta)
         _obs.event("checkpoint_restore", step=meta["step"], path=stepdir)
         _obs.inc("checkpoint.restored")

@@ -1,0 +1,367 @@
+"""Distributed fault tolerance primitives: sharded snapshots + desync checks.
+
+Two jobs, both host-level (no device collectives — everything here is safe
+from checkpoint writer threads):
+
+**Sharded checkpoint state.** In a multi-controller run no single host can
+``np.asarray`` the training state: FSDP shards live across processes. Each
+host therefore snapshots only the blocks it OWNS — addressable shards with
+``replica_id == 0``, so a block replicated across hosts is written exactly
+once — plus, on host 0, every fully-replicated/host-local leaf. The writer
+side (``CheckpointManager._write_sharded``) lands each host's blocks in a
+``shard-<p>/`` dir; ``read_sharded_state`` reassembles full global arrays
+from any number of shard dirs, which is what makes restore work onto a
+DIFFERENT host count (the merged manifest + per-block start/shape metadata
+carry everything needed; placement is re-derived from the live params).
+
+**Desync detection.** The failure mode of lockstep SPMD is not a crash but
+a hang: one host skips a step the others took, and the next collective
+waits forever. ``check_in_sync`` publishes each host's (step, program-key)
+through the coordination service's KV store and compares — a mismatch or an
+unresponsive peer raises a reason-coded ``DesyncError`` (bus event
+``desync`` + ``desync.<kind>`` counter) instead of a silent hang.
+``CheckpointManager.save`` runs it before every distributed save, so the
+checkpoint barrier doubles as the fleet's health check.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..observability import events as _obs
+from ..observability import metrics as _obs_metrics
+
+SHARDED_FORMAT = "checkpoint-v2-sharded"
+SHARD_PREFIX = "shard-"
+_STATE_FILE = "state.npz"
+_SHARD_META = "shard_meta.json"
+
+
+class DesyncError(RuntimeError):
+    """Cross-host divergence (step counter / program key / dead peer)
+    detected before it could hang a collective. Carries ``hosts``: the
+    per-host values observed (None for an unresponsive peer)."""
+
+    def __init__(self, message: str, *, hosts: Optional[dict] = None):
+        super().__init__(message)
+        self.hosts = dict(hosts or {})
+
+
+# this host's key from the PREVIOUS completed check: deleted lazily at the
+# next check (by then every peer has read it — checks are barriers), so the
+# coordinator's KV store stays bounded over a long run
+_PREV_KEY: Optional[str] = None
+
+
+def check_in_sync(step: int, key: str = "", *, timeout_s: float = 60.0) -> dict:
+    """All-host agreement on (step, program key). Returns {host: value} on
+    agreement; raises DesyncError on divergence or an unresponsive peer.
+    Single-process runs agree trivially.
+
+    The KV tag is DETERMINISTIC — ``(key, step)`` — never a call-count
+    generation or an attempt counter: a host that skipped one check (a
+    failed save, a preemption race, an asymmetric timeout) must not poison
+    the tag alignment of every later check. Re-checking the same (key,
+    step) is idempotent (the published values are equal by construction).
+    A desynced peer therefore surfaces as a timeout on its missing entry,
+    after which a best-effort KV scan distinguishes "published a DIFFERENT
+    step" (kind=mismatch, with the peer's values) from "never published at
+    all" (kind=unresponsive)."""
+    from ..parallel import multiprocess as mp
+
+    global _PREV_KEY
+    val = f"{step}:{key}"
+    if mp.process_count() <= 1:
+        return {0: val}
+    me = mp.process_index()
+    client = mp.coordinator_client()
+    if _PREV_KEY is not None and client is not None:
+        try:
+            client.key_value_delete(_PREV_KEY)
+        except Exception:
+            pass
+        _PREV_KEY = None
+    tag = f"{key}:{step}"
+    try:
+        got = mp.kv_agree(tag, val, timeout_s=timeout_s)
+    except Exception as e:
+        divergent = _scan_divergent_peers(client, tag, me)
+        if divergent:
+            _obs_metrics.record_desync("mismatch", step=step, host=me,
+                                       hosts=divergent)
+            raise DesyncError(
+                f"hosts desynchronized at step {step}: this host is at "
+                f"{tag!r} but peers published {divergent} — refusing to "
+                f"continue into a hanging collective", hosts=divergent) from e
+        _obs_metrics.record_desync("unresponsive", step=step, host=me,
+                                   error=f"{type(e).__name__}: {e}"[:200])
+        raise DesyncError(
+            f"desync check at step {step}: a peer host never reported "
+            f"within {timeout_s:.0f}s (dead, or hung before its "
+            f"{tag!r} check); refusing to continue into a hanging "
+            f"collective") from e
+    _PREV_KEY = f"tt_agree/{tag}/{me}"
+    if _obs.enabled():
+        _obs.inc("desync.check_ok")
+    return got
+
+
+def _scan_divergent_peers(client, tag: str, me: int) -> dict:
+    """Best-effort: entries peers published under OTHER tags (they reached a
+    different step/attempt) — the diagnostic half of a timed-out check."""
+    if client is None:
+        return {}
+    try:
+        entries = client.key_value_dir_get("tt_agree/")
+    except Exception:
+        return {}
+    out = {}
+    for k, v in entries:
+        parts = k.split("/")
+        if len(parts) != 3 or parts[1] == tag:
+            continue
+        try:
+            host = int(parts[2])
+        except ValueError:
+            continue
+        if host != me:
+            out[str(host)] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-shard snapshots
+# ---------------------------------------------------------------------------
+
+
+def _leaf_paths_and_values(state) -> tuple[list[str], list]:
+    """Deterministic (paths, leaves) for a state tree — path strings ride in
+    shard_meta so offline tools (ckpt_inspect --merge) can name leaves
+    without reconstructing the tree."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    paths = [jax.tree_util.keystr(kp) for kp, _ in flat]
+    leaves = [v for _, v in flat]
+    return paths, leaves
+
+
+@dataclass
+class HostShardSnapshot:
+    """One host's slice of the training state, materialized to numpy (the
+    step loop may donate the device buffers on the very next step)."""
+
+    host: int
+    n_hosts: int
+    n_leaves: int
+    leaf_meta: dict = field(default_factory=dict)  # str(i) -> meta dict
+    entries: dict = field(default_factory=dict)    # npz key -> np.ndarray
+    nbytes: int = 0
+
+
+def snapshot_host_shards(state) -> HostShardSnapshot:
+    """Snapshot the leaves (or leaf blocks) THIS host owns.
+
+    Ownership: fully-addressable and fully-replicated leaves belong to host
+    0 (one canonical copy in the checkpoint); cross-host sharded leaves
+    contribute their addressable ``replica_id == 0`` blocks, so every block
+    of the global array is written exactly once fleet-wide."""
+    import jax
+
+    try:
+        host = int(jax.process_index())
+        n_hosts = int(jax.process_count())
+    except Exception:
+        host, n_hosts = 0, 1
+    paths, leaves = _leaf_paths_and_values(state)
+    snap = HostShardSnapshot(host=host, n_hosts=n_hosts, n_leaves=len(leaves))
+    for i, (path, leaf) in enumerate(zip(paths, leaves)):
+        dt = getattr(leaf, "dtype", None)  # cross-host arrays must not be
+        if dt is None:                     # np.asarray'd just for a dtype
+            dt = np.asarray(leaf).dtype
+        meta = {"path": path,
+                "global_shape": list(np.shape(leaf)),
+                "dtype": str(dt)}
+        is_jax = isinstance(leaf, jax.Array)
+        if not is_jax or leaf.is_fully_addressable or leaf.is_fully_replicated:
+            meta["kind"] = "full"
+            if host == 0:
+                if is_jax and not leaf.is_fully_addressable:
+                    # fully replicated across hosts: any local shard IS the
+                    # full value (np.asarray on the parent would require
+                    # full addressability on some jax versions)
+                    arr = np.asarray(leaf.addressable_shards[0].data)
+                else:
+                    arr = np.asarray(leaf)
+                key = f"L{i}.full"
+                snap.entries[key] = arr
+                meta["entry"] = key
+                snap.nbytes += arr.nbytes
+        else:
+            blocks = []
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                data = np.asarray(shard.data)
+                start = [0 if sl.start is None else int(sl.start)
+                         for sl in shard.index]
+                key = f"L{i}.b{len(blocks)}"
+                snap.entries[key] = data
+                blocks.append({"start": start, "shape": list(data.shape),
+                               "entry": key})
+                snap.nbytes += data.nbytes
+            meta["kind"] = "blocks"
+            meta["blocks"] = blocks
+        snap.leaf_meta[str(i)] = meta
+    return snap
+
+
+def write_host_shard(snap: HostShardSnapshot, shard_dir: str) -> None:
+    """Write one host's snapshot into ``shard_dir`` (payload + metadata).
+    Atomicity is the caller's job (tmp dir + os.replace — the manager's
+    commit protocol)."""
+    os.makedirs(shard_dir, exist_ok=True)
+    # keep the dtype-name manifest INSIDE the npz (the dist_ckpt idiom):
+    # np.savez degrades extension dtypes (bfloat16/fp8) to raw void bytes
+    keys = sorted(snap.entries)
+    dtype_names = {k: str(snap.entries[k].dtype) for k in keys}
+    with open(os.path.join(shard_dir, _STATE_FILE), "wb") as f:
+        np.savez(f, __tt_dtypes__=np.array(json.dumps(dtype_names)),
+                 **{k: snap.entries[k] for k in keys})
+    meta = {"host": snap.host, "n_hosts": snap.n_hosts,
+            "n_leaves": snap.n_leaves, "leaves": snap.leaf_meta}
+    with open(os.path.join(shard_dir, _SHARD_META), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+
+
+def list_shard_dirs(stepdir: str) -> list[tuple[int, str]]:
+    """[(host, abspath)] of shard dirs inside a sharded checkpoint step."""
+    out = []
+    for name in os.listdir(stepdir):
+        if not name.startswith(SHARD_PREFIX):
+            continue
+        try:
+            host = int(name[len(SHARD_PREFIX):])
+        except ValueError:
+            continue
+        path = os.path.join(stepdir, name)
+        if os.path.isdir(path):
+            out.append((host, path))
+    out.sort()
+    return out
+
+
+def is_sharded_checkpoint(stepdir: str) -> bool:
+    return bool(list_shard_dirs(stepdir))
+
+
+def _np_dtype(name: str) -> np.dtype:
+    from ..parallel.checkpoint import _np_dtype as resolve
+
+    return resolve(name)
+
+
+def _load_shard_entries(shard_dir: str) -> tuple[dict, dict]:
+    """(shard_meta, {entry key: array}) with extension dtypes viewed back."""
+    with open(os.path.join(shard_dir, _SHARD_META)) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(shard_dir, _STATE_FILE))
+    names = json.loads(str(data["__tt_dtypes__"])) if "__tt_dtypes__" in data.files else {}
+    entries = {}
+    for k in data.files:
+        if k == "__tt_dtypes__":
+            continue
+        a = data[k]
+        want = names.get(k)
+        if want and str(a.dtype) != want:
+            a = a.view(_np_dtype(want))
+        entries[k] = a
+    return meta, entries
+
+
+def read_sharded_state(stepdir: str) -> tuple[list[np.ndarray], list[str]]:
+    """Reassemble full global arrays from every shard dir under ``stepdir``.
+    Returns (leaves, paths) in the state tree's flatten order. Raises
+    ValueError naming the missing host/blocks when coverage is incomplete —
+    the error an operator sees when a host's shard was lost."""
+    shard_dirs = list_shard_dirs(stepdir)
+    if not shard_dirs:
+        raise ValueError(f"{stepdir} has no {SHARD_PREFIX}* dirs — not a "
+                         f"sharded checkpoint")
+    metas = {}
+    entries = {}
+    n_hosts = None
+    for host, path in shard_dirs:
+        meta, ent = _load_shard_entries(path)
+        metas[host] = meta
+        entries[host] = ent
+        n_hosts = meta.get("n_hosts", n_hosts)
+    if n_hosts is not None:
+        missing = sorted(set(range(n_hosts)) - set(metas))
+        if missing:
+            raise ValueError(
+                f"sharded checkpoint {stepdir} is missing host shard(s) "
+                f"{missing} (wrote {n_hosts} hosts, found {sorted(metas)})")
+    n_leaves = {m["n_leaves"] for m in metas.values()}
+    if len(n_leaves) != 1:
+        raise ValueError(f"shard metadata disagrees on leaf count: {n_leaves}")
+    n = n_leaves.pop()
+    leaves: list[np.ndarray] = []
+    paths: list[str] = []
+    for i in range(n):
+        key = str(i)
+        # every shard records every leaf's meta; take host-ordered first
+        meta0 = next(m["leaves"][key] for _, m in sorted(metas.items()))
+        paths.append(meta0["path"])
+        shape = tuple(meta0["global_shape"])
+        full = None
+        for host in sorted(metas):
+            lm = metas[host]["leaves"].get(key, {})
+            if lm.get("kind") == "full" and lm.get("entry") in entries[host]:
+                full = entries[host][lm["entry"]]
+                break
+        if full is not None:
+            leaves.append(full)
+            continue
+        dtype = _np_dtype(meta0["dtype"])
+        out = np.zeros(shape, dtype)
+        covered = 0
+        for host in sorted(metas):
+            lm = metas[host]["leaves"].get(key, {})
+            for blk in lm.get("blocks", ()):
+                start, bshape = blk["start"], blk["shape"]
+                sl = tuple(slice(s, s + w) for s, w in zip(start, bshape))
+                block = entries[host].get(blk["entry"])
+                if block is None:
+                    raise ValueError(
+                        f"shard-{host} metadata lists {blk['entry']} for "
+                        f"leaf {meta0['path']} but the payload lacks it")
+                out[sl] = block.reshape(bshape)
+                covered += int(np.prod(bshape))
+        size = int(np.prod(shape)) if shape else 1
+        if covered != size:
+            raise ValueError(
+                f"leaf {meta0['path']} incompletely covered by shards: "
+                f"{covered}/{size} elements (a host shard is missing "
+                f"blocks — restore refused rather than zero-filling)")
+        leaves.append(out)
+    return leaves, paths
+
+
+def load_sharded_state(stepdir: str, like: dict) -> dict:
+    """Reassemble and unflatten into ``like``'s tree structure (the same
+    contract as parallel/checkpoint.load's numpy fallback)."""
+    import jax
+
+    leaves, paths = read_sharded_state(stepdir)
+    flat, treedef = jax.tree_util.tree_flatten(like)
+    if len(flat) != len(leaves):
+        raise ValueError(
+            f"sharded checkpoint {stepdir} holds {len(leaves)} leaves but "
+            f"the live state expects {len(flat)} — model/optimizer structure "
+            f"changed since the save (first stored: {paths[:3]})")
+    return jax.tree_util.tree_unflatten(treedef, leaves)

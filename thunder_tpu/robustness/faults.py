@@ -14,9 +14,23 @@ at an exact step index, so each policy's observable outcome is pinned by CI:
   preempt     deliver a real SIGTERM to this process after the step completes
               (exercises the PreemptionHandler -> final save -> Preempted path)
 
+Distributed runs add two things (ISSUE 14): a fifth kind and a host scope:
+
+  die         kill THIS process abruptly (``os._exit``) at dispatch time —
+              no atexit, no finally, no final checkpoint; the real shape of
+              a host lost mid-step (exercises kill-one-host-and-resume)
+
+  ``:host=<p>`` scopes any fault to one process of a multi-process run
+  (``nan_loss@5:host=1`` poisons only host 1's batch — the psum'd guard
+  gate must still skip the step on EVERY host). Unscoped faults fire on
+  every host. The host index resolves lazily (``jax.process_index()`` once
+  a fault is consulted, falling back to the TT_MP_PROC env var before jax
+  initializes) so arming a plan never forces jax import or distributed
+  init.
+
 Enablement:
   TT_FAULT=nan_loss@5,transient@7*2,preempt@9    env knob, parsed at import
-  faults.configure("ckpt_fail@4")                the same, programmatically
+  faults.configure("ckpt_fail@4:host=1")         the same, programmatically
   faults.clear()                                 disarm (tests)
 
 ``<kind>@<step>`` fires once at 0-based step index ``step``; ``*<count>``
@@ -36,7 +50,11 @@ from typing import Optional
 
 import numpy as np
 
-KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt")
+KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt", "die")
+
+# exit status of an injected `die` fault: distinct from every python/pytest
+# code so the multi-process harness can assert the host died BY INJECTION
+DIE_EXIT_CODE = 77
 
 
 class InjectedTransientError(RuntimeError):
@@ -48,20 +66,55 @@ class InjectedCheckpointError(OSError):
 
 
 class _Fault:
-    __slots__ = ("kind", "step", "count", "fired")
+    __slots__ = ("kind", "step", "count", "fired", "host")
 
-    def __init__(self, kind: str, step: int, count: int = 1):
+    def __init__(self, kind: str, step: int, count: int = 1,
+                 host: Optional[int] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; expected one of {KINDS}")
         if step < 0 or count < 1:
             raise ValueError(f"fault {kind}@{step}*{count}: step must be >= 0, count >= 1")
+        if host is not None and host < 0:
+            raise ValueError(f"fault {kind}@{step}: host index must be >= 0, got {host}")
         self.kind = kind
         self.step = step
         self.count = count
         self.fired = 0
+        self.host = host
 
     def __repr__(self) -> str:
-        return f"{self.kind}@{self.step}*{self.count}(fired={self.fired})"
+        scope = "" if self.host is None else f":host={self.host}"
+        return f"{self.kind}@{self.step}*{self.count}{scope}(fired={self.fired})"
+
+
+# lazily-resolved process index for host-scoped faults: None until a scoped
+# fault is actually consulted, so arming a plan never imports jax or touches
+# distributed state. TT_MP_PROC (the LocalCluster harness env) wins over
+# jax.process_index() only before jax distributed-initializes.
+_HOST_INDEX: Optional[int] = None
+
+
+def _host_index() -> int:
+    global _HOST_INDEX
+    if _HOST_INDEX is None:
+        env = os.environ.get("TT_MP_PROC")
+        if env is not None:
+            _HOST_INDEX = int(env)
+        else:
+            try:
+                import jax
+
+                _HOST_INDEX = int(jax.process_index())
+            except Exception:
+                _HOST_INDEX = 0
+    return _HOST_INDEX
+
+
+def _reset_host_index() -> None:
+    """Test seam: re-resolve the process index (the cache would otherwise
+    leak a host index across tests that monkeypatch TT_MP_PROC)."""
+    global _HOST_INDEX
+    _HOST_INDEX = None
 
 
 class FaultPlan:
@@ -79,21 +132,35 @@ class FaultPlan:
                 continue
             if "@" not in part:
                 raise ValueError(
-                    f"bad TT_FAULT entry {part!r}: expected <kind>@<step>[*<count>]")
+                    f"bad TT_FAULT entry {part!r}: expected "
+                    f"<kind>@<step>[*<count>][:host=<p>]")
             kind, _, rest = part.partition("@")
+            host = None
+            if ":" in rest:
+                rest, _, scope = rest.partition(":")
+                skey, _, sval = scope.partition("=")
+                if skey.strip() != "host" or not sval:
+                    raise ValueError(
+                        f"bad TT_FAULT scope {scope!r} in {part!r}: "
+                        f"expected :host=<process index>")
+                host = int(sval)
             count = 1
             if "*" in rest:
                 rest, _, cnt = rest.partition("*")
                 count = int(cnt)
-            faults.append(_Fault(kind.strip(), int(rest), count))
+            faults.append(_Fault(kind.strip(), int(rest), count, host=host))
         return cls(faults)
 
     def should_fire(self, kind: str, step: int) -> bool:
         """True (and consumes one firing) if a fault of `kind` is armed for
         this step. A fault with count K fires at K consecutive opportunities
-        starting at its step index."""
+        starting at its step index; a host-scoped fault fires only in the
+        process whose index matches (and is never consumed elsewhere, so a
+        spec shared via env across a whole cluster stays deterministic)."""
         for f in self.faults:
             if f.kind != kind or f.fired >= f.count:
+                continue
+            if f.host is not None and f.host != _host_index():
                 continue
             if step >= f.step:
                 f.fired += 1
@@ -163,6 +230,21 @@ def maybe_poison(args: tuple, kwargs: dict, step: int):
     raise RuntimeError(
         "nan_loss fault: the batch has no float array leaf to poison "
         "(integer token batches cannot carry a NaN; poison a float input)")
+
+
+def maybe_die(step: int) -> None:
+    """die site: kill THIS process the way a lost host dies — ``os._exit``,
+    no atexit hooks, no finally blocks, no draining checkpoint. Peers block
+    in their next collective until the runtime surfaces the dead peer. The
+    distinct exit code lets the harness assert the death was the injection,
+    not a crash."""
+    if _PLAN is None or not _PLAN.should_fire("die", step):
+        return
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(DIE_EXIT_CODE)
 
 
 def maybe_preempt(step: int) -> None:

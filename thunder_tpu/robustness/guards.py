@@ -107,11 +107,28 @@ class StepGuard:
         # (corrupt data replayed from the same restored cursor) must raise
         # on the second exhausted budget, not livelock restoring forever
         self._rollbacks_since_good = 0
+        # set by TrainStep._build under a mesh plan: the finite flag is then
+        # a psum'd ALL-HOST verdict, and every intervention below is also
+        # recorded as a guard.dist_* agreement counter so cross-host counter
+        # dumps can be diffed for lockstep (tests/test_multiprocess.py)
+        self.distributed = False
+
+    def mark_distributed(self) -> None:
+        self.distributed = True
 
     def program_key(self) -> str:
         """The part of the guard config that changes the traced program
         (folded into the AOT step cache key)."""
         return f"guard(gnorm={self.policy.check_grad_norm})"
+
+    def _record(self, reason: str, **attrs) -> None:
+        """Reason-coded intervention event/counter; under a distributed
+        verdict the same reason is additionally bumped as guard.dist_<reason>
+        so per-host counter dumps can be diffed for lockstep agreement."""
+        if self.distributed:
+            _obs_metrics.record_dist_verdict(reason, **attrs)
+        else:
+            _obs_metrics.record_intervention(reason, **attrs)
 
     # -- nonfinite policy ---------------------------------------------------
 
@@ -119,6 +136,7 @@ class StepGuard:
         """Called by TrainStep.__call__ after the jitted step returns.
         ``metrics`` is the (finite, grad_norm) pair the program computed."""
         finite, gnorm = metrics
+        rec = self._record
         if bool(finite):  # host sync: the price of guarding
             self.consecutive_bad = 0
             self._rollbacks_since_good = 0
@@ -128,40 +146,34 @@ class StepGuard:
         step = train_step._step_count
         gnorm_f = float(gnorm) if pol.check_grad_norm else None
         if pol.on_nonfinite == "raise":
-            _obs_metrics.record_intervention(
-                "nonfinite-raise", step=step, grad_norm=gnorm_f)
+            rec("nonfinite-raise", step=step, grad_norm=gnorm_f)
             raise NonFiniteLossError(
                 f"non-finite loss/grad at step {step} "
                 f"(loss={float(loss)!r}, grad_norm={gnorm_f!r})")
         if pol.on_nonfinite == "skip":
             self.skipped += 1
-            _obs_metrics.record_intervention(
-                "nonfinite-skip", step=step, consecutive=self.consecutive_bad,
+            rec("nonfinite-skip", step=step, consecutive=self.consecutive_bad,
                 grad_norm=gnorm_f)
             if self.consecutive_bad >= pol.max_consecutive:
-                _obs_metrics.record_intervention(
-                    "nonfinite-raise", step=step, after_skips=self.consecutive_bad)
+                rec("nonfinite-raise", step=step, after_skips=self.consecutive_bad)
                 raise NonFiniteLossError(
                     f"{self.consecutive_bad} consecutive non-finite steps "
                     f"(budget {pol.max_consecutive}); last at step {step}")
             return
         # rollback
         self.skipped += 1
-        _obs_metrics.record_intervention(
-            "nonfinite-skip", step=step, consecutive=self.consecutive_bad,
+        rec("nonfinite-skip", step=step, consecutive=self.consecutive_bad,
             grad_norm=gnorm_f)
         if self.consecutive_bad < pol.max_consecutive:
             return
         mgr = getattr(train_step, "_ckpt_manager", None)
         if mgr is None:
-            _obs_metrics.record_intervention("nonfinite-raise", step=step,
-                                             rollback="no-manager")
+            rec("nonfinite-raise", step=step, rollback="no-manager")
             raise NonFiniteLossError(
                 f"{self.consecutive_bad} consecutive non-finite steps and no "
                 f"CheckpointManager attached to roll back to (step {step})")
         if self._rollbacks_since_good >= 1:
-            _obs_metrics.record_intervention("nonfinite-raise", step=step,
-                                             rollback="budget-exhausted")
+            rec("nonfinite-raise", step=step, rollback="budget-exhausted")
             raise NonFiniteLossError(
                 f"non-finite steps persisted through a rollback (step {step}); "
                 f"the fault is deterministic (bad data/model), not transient — "
@@ -170,8 +182,7 @@ class StepGuard:
         self.rollbacks += 1
         self._rollbacks_since_good += 1
         self.consecutive_bad = 0
-        _obs_metrics.record_intervention(
-            "rollback", step=step, restored_step=restored.get("step"))
+        rec("rollback", step=step, restored_step=restored.get("step"))
         warnings.warn(
             f"rolled back to checkpoint step {restored.get('step')} after "
             f"{self.policy.max_consecutive} consecutive non-finite steps",

@@ -31,7 +31,7 @@ class Preempted(RuntimeError):
 
 
 class PreemptionHandler:
-    """Chainable SIGTERM/SIGINT trap exposing a ``preempted`` event.
+    """Chainable SIGTERM/SIGINT trap exposing ``preempted``/``escalated``.
 
     The handler body only sets the event and emits a bus event — signal
     context is the wrong place for checkpoint IO or exceptions. A previously
@@ -40,21 +40,41 @@ class PreemptionHandler:
     process instantly, which is exactly what a drained shutdown must avoid).
     ``install`` outside the main thread degrades gracefully: signals cannot
     be trapped there, but ``preempted`` can still be set programmatically.
+
+    A SECOND signal during the drain window means the fleet scheduler is
+    impatient: it sets ``escalated`` (CheckpointManager then skips every
+    courtesy wait and goes straight to an immediate blocking save) and does
+    NOT re-chain the previous handler — re-entering foreign signal handlers
+    on a repeat signal mid-drain is how drains wedge.
+
+    SIGINT coverage is opt-in: ``PreemptionHandler(signals=(signal.SIGTERM,
+    signal.SIGINT))`` (or ``CheckpointManager(signals=...)``) gives Ctrl-C
+    the same drain-and-save semantics interactive runs want.
     """
 
     def __init__(self, signals=(signal.SIGTERM,)):
         self.signals = tuple(signals)
         self.preempted = threading.Event()
+        self.escalated = threading.Event()
         self._prev: dict = {}
         self._installed = False
 
     def _handler(self, signum, frame):
         first = not self.preempted.is_set()
         self.preempted.set()
-        if first and _obs.enabled():
+        if not first:
+            # repeat signal during the drain: escalate, never re-enter
+            self.escalated.set()
+            if _obs.enabled():
+                _obs.event("preempt_signal", signum=int(signum), escalated=True)
+            return
+        if _obs.enabled():
             _obs.event("preempt_signal", signum=int(signum))
         prev = self._prev.get(signum)
-        if callable(prev):
+        # default_int_handler is SIGINT's "default disposition as a callable":
+        # chaining it would raise KeyboardInterrupt inside the drain window —
+        # exactly the instant death opt-in SIGINT coverage exists to avoid
+        if callable(prev) and prev is not signal.default_int_handler:
             prev(signum, frame)
 
     def install(self) -> "PreemptionHandler":

@@ -279,10 +279,10 @@ class TrainStep:
         optimizer = self.optimizer
         guard = self._guard
         if guard is not None and plan is not None:
-            raise NotImplementedError(
-                "step guards are not supported with a distributed plan yet; "
-                "guard single-host steps, or rely on checkpoint/restart for "
-                "sharded runs")
+            # host-side policy decisions must come from an ALL-HOST verdict
+            # (see the psum in raw_step below); mark the guard so after_step
+            # records the distributed agreement counters
+            guard.mark_distributed()
         check_gnorm = guard is not None and guard.policy.check_grad_norm
         vag = self._make_vag(sync_loss=True)
         self._vag = vag
@@ -306,11 +306,26 @@ class TrainStep:
                 # to happen inside the program — under buffer donation the
                 # old arrays no longer exist anywhere the host could reach
                 # by the time it observes the loss.
-                gnorm = (_global_norm(param_grads) if check_gnorm
-                         else jnp.zeros((), jnp.float32))
+                if check_gnorm:
+                    gnorm = (_dist_global_norm(param_grads, plan)
+                             if plan is not None else _global_norm(param_grads))
+                else:
+                    gnorm = jnp.zeros((), jnp.float32)
                 finite = jnp.isfinite(loss)
                 if check_gnorm:
                     finite = jnp.logical_and(finite, jnp.isfinite(gnorm))
+                if plan is not None:
+                    # distributed verdict — "one psum away" (ROADMAP #1):
+                    # a NaN in ANY shard (one host's batch, one param shard's
+                    # grads) must gate the update on EVERY device, or the
+                    # replicas diverge and every later step is garbage. One
+                    # psum of the local badness over ALL mesh axes turns the
+                    # local flag into the all-host agreement.
+                    axes = tuple(plan.mesh.axis_names)
+                    axes = axes if len(axes) > 1 else axes[0]
+                    bad = jax.lax.psum(
+                        jnp.where(finite, 0, 1).astype(jnp.int32), axes)
+                    finite = bad == 0
                 new_params = {k: jnp.where(finite, v, tparam_arrays[k])
                               for k, v in new_params.items()}
                 new_state = jax.tree_util.tree_map(
@@ -357,7 +372,8 @@ class TrainStep:
                 return out
 
             self._jitted = _shard_mapped_step(raw_step_dist, plan, self.tmodule, self.opt_state,
-                                              batch_args, batch_kwargs, donate)
+                                              batch_args, batch_kwargs, donate,
+                                              guarded=guard is not None)
 
     # -- AOT executable cache (utils/aot_cache.py): warm process start
     # deserializes the compiled whole-step program — no trace, no lowering,
@@ -524,6 +540,10 @@ class TrainStep:
         step_idx = self._step_count
         if g is None or g.policy.retry_transient <= 0:
             if _rb_faults.active():
+                # `die` kills the process mid-step (host-death injection) —
+                # deliberately OUTSIDE any retry loop: a dead host does not
+                # retry, its peers discover it through the runtime
+                _rb_faults.maybe_die(step_idx)
                 _rb_faults.maybe_raise("transient", step_idx)
             return self._jitted(*jit_args)
 
@@ -533,6 +553,9 @@ class TrainStep:
             if _rb_faults.active():
                 _rb_faults.maybe_raise("transient", step_idx)
             return self._jitted(*jit_args)
+
+        if _rb_faults.active():
+            _rb_faults.maybe_die(step_idx)
 
         return g.run_with_retry(attempt, step=step_idx)
 
@@ -593,9 +616,22 @@ class TrainStep:
             # submission latency unless the caller reads the loss value).
             # Gated on the obs_on read from call entry: the disabled-mode
             # steady-state path must not call into the observability layer
-            with _obs.span("train_step") if sampled else _NULL_SPAN:
-                out = self._dispatch(
-                    tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            try:
+                with _obs.span("train_step") if sampled else _NULL_SPAN:
+                    out = self._dispatch(
+                        tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
+            except BaseException:
+                # a step that dies while the FLEET is draining (a preempted
+                # peer stopped stepping, so this host's collective had no
+                # counterparty) is the drain arriving, not a crash: finalize
+                # the preemption from the last completed step instead of
+                # surfacing a dead-collective error. Zero cost on healthy
+                # failures without a manager; with one, the KV read happens
+                # only on this (already exceptional) path.
+                mgr = self._ckpt_manager
+                if mgr is not None and (mgr.preempted or mgr._peer_preempted()):
+                    mgr._finalize_preempt(self)  # raises Preempted
+                raise
             if self._guard is not None:
                 loss, new_params, self.opt_state, effects, gmetrics = out
             else:
@@ -998,6 +1034,28 @@ class TrainStep:
         return jitted.lower(tparams, fparams, self.opt_state, args, kwargs).compile().memory_analysis()
 
 
+def _dist_global_norm(param_grads: dict, plan):
+    """TRUE global gradient norm inside a shard_map'd step: per param, the
+    local sum-of-squares is psum'd over exactly the axes that param's grad
+    is SHARDED on (shard0/column/row) and counted once over the axes it is
+    replicated on — a blanket psum would overcount replicated grads by the
+    world size, a bare local norm would understate sharded ones by √shards.
+    The result is identical on every device (replicated components are
+    equal, psum'd components are collective outputs), so it rides the P()
+    out-spec unchanged."""
+    strategies = plan.param_strategies
+    total = jnp.zeros((), jnp.float32)
+    for k, g in param_grads.items():
+        ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        shard_axes = tuple(st.axis for st in strategies.get(k, ())
+                           if st.kind in ("shard0", "column", "row"))
+        if shard_axes:
+            ss = jax.lax.psum(ss, shard_axes if len(shard_axes) > 1
+                              else shard_axes[0])
+        total = total + ss
+    return jnp.sqrt(total)
+
+
 def _batch_pspec(plan, leaf):
     from jax.sharding import PartitionSpec as P
 
@@ -1030,13 +1088,18 @@ def _opt_state_specs(opt_state, param_specs: dict):
 
 
 def _shard_map_compat(fn, mesh, in_specs, out_specs):
-    """jax.shard_map across the check_vma/check_rep keyword rename."""
+    """shard_map across jax API moves: jax.shard_map (new) falls back to
+    jax.experimental.shard_map (0.4.x), and the check_vma kwarg falls back
+    to its old name check_rep."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
     try:
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        return sm(fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=False)
     except TypeError:  # older jax: check_rep
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False)
+        return sm(fn, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=False)
 
 
 def _dist_in_specs(plan, trainable, frozen, batch_args, batch_kwargs):
@@ -1049,11 +1112,14 @@ def _dist_in_specs(plan, trainable, frozen, batch_args, batch_kwargs):
     return param_specs, frozen_specs, args_specs, kwargs_specs
 
 
-def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwargs, donate):
+def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwargs, donate,
+                       *, guarded: bool = False):
     """Wrap the step in shard_map over the plan's mesh: params/opt-state use
     per-param specs, batch leaves shard dim 0 over the data axes, loss comes
     back replicated. XLA lowers the recorded collective prims to ICI
-    collectives and overlaps them with compute."""
+    collectives and overlaps them with compute. A guarded step returns two
+    extra outputs — the psum'd finite verdict and the pmax'd grad norm —
+    both replicated, so every host's after_step reads the same decision."""
     from jax.sharding import PartitionSpec as P
 
     all_params = dict(tmodule.get_parameters())
@@ -1064,10 +1130,26 @@ def _shard_mapped_step(raw_step, plan, tmodule, opt_state, batch_args, batch_kwa
     frozen = {k: getattr(p, "data", p) for k, p in all_params.items() if k not in trainable}
     if opt_state is None:
         raise RuntimeError("opt_state must be initialized before building the distributed step")
+    if plan.data_axes:
+        # loud divisibility check: shard_map's own failure on an uneven
+        # batch is an anonymous AssertionError deep in spec matching
+        dp_world = 1
+        for a in plan.data_axes:
+            dp_world *= plan.world_size(a)
+        for leaf in jax.tree_util.tree_leaves((batch_args, batch_kwargs)):
+            shape = getattr(leaf, "shape", None)
+            if shape and shape[0] % dp_world:
+                raise ValueError(
+                    f"batch dim 0 ({shape[0]}) is not divisible by the "
+                    f"data-parallel world size {dp_world} (axes "
+                    f"{plan.data_axes}); pad or resize the batch")
     param_specs, frozen_specs, args_specs, kwargs_specs = _dist_in_specs(
         plan, trainable, frozen, batch_args, batch_kwargs)
     opt_specs = _opt_state_specs(opt_state, param_specs)
+    out_specs = (P(), param_specs, opt_specs, ())
+    if guarded:
+        out_specs = out_specs + ((P(), P()),)
     smapped = _shard_map_compat(raw_step, plan.mesh,
                                 (param_specs, frozen_specs, opt_specs, args_specs, kwargs_specs),
-                                (P(), param_specs, opt_specs, ()))
+                                out_specs)
     return jax.jit(smapped, donate_argnums=donate)

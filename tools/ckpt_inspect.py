@@ -6,12 +6,21 @@ restorable-state summary from ``meta.json`` (step counter, param/buffer/
 optimizer leaf counts, loader cursor). The operator-facing answer to "can I
 actually resume from this?" before a job is pointed at it.
 
+Sharded (multi-host) checkpoints — ``shard-<p>/`` dirs + the merged
+manifest host 0 published — validate host-aware: every written host's
+shard must be present (a deleted ``shard-1/`` reports ``missing host
+shard``) and extra/unknown shard dirs are flagged. ``--merge OUT``
+reassembles the per-host shards into a classic single-host checkpoint
+offline, so a sharded checkpoint from a dead 4-host fleet restores on one
+box (or a different host count) with the stock restore path.
+
 Usage:
     python tools/ckpt_inspect.py CKPT_DIR            # list + validate all steps
     python tools/ckpt_inspect.py CKPT_DIR --step N   # one step, full detail
+    python tools/ckpt_inspect.py CKPT_DIR --step N --merge OUT_DIR
 
-Exit codes: 0 all listed checkpoints valid, 1 at least one invalid,
-2 no checkpoints found / unreadable directory.
+Exit codes: 0 all listed checkpoints valid (/merge succeeded), 1 at least
+one invalid (/merge failed), 2 no checkpoints found / unreadable directory.
 """
 from __future__ import annotations
 
@@ -26,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from thunder_tpu.robustness.checkpoint_manager import (  # noqa: E402
     list_steps,
     read_meta,
+    step_dir_name,
     validate_step,
 )
 
@@ -39,6 +49,61 @@ def _dir_bytes(path: str) -> int:
             except OSError:
                 pass
     return total
+
+
+def shard_report(stepdir: str) -> tuple[list[str], str]:
+    """(problems, summary) for the host-shard layout of a step dir.
+    Non-sharded checkpoints return ([], ""). A missing host shard is a
+    restore-blocking problem; an extra (unknown-host) shard dir means the
+    manifest and the directory disagree about the fleet that wrote it."""
+    from thunder_tpu.robustness import distributed as rdist
+
+    present = {h for h, _ in rdist.list_shard_dirs(stepdir)}
+    want = None
+    try:
+        with open(os.path.join(stepdir, "manifest.json")) as f:
+            want = json.load(f).get("hosts")
+    except (OSError, json.JSONDecodeError):
+        pass
+    if want is None and not present:
+        return [], ""
+    problems = []
+    if want is not None:
+        for h in sorted(set(range(want)) - present):
+            problems.append(f"missing host shard: shard-{h}")
+        for h in sorted(present - set(range(want))):
+            problems.append(f"extra host shard: shard-{h} (manifest says {want} hosts)")
+    summary = f"shards={len(present)}" + (f"/{want}" if want is not None else "")
+    return problems, summary
+
+
+def merge_step(stepdir: str, out_dir: str) -> str:
+    """Consolidate a sharded checkpoint into a classic single-host step dir
+    under ``out_dir`` (offline — no jax cluster needed). The output restores
+    through the stock CheckpointManager path on any host count."""
+    from thunder_tpu.parallel.checkpoint import write_flat_npz
+    from thunder_tpu.robustness import distributed as rdist
+    from thunder_tpu.robustness.checkpoint_manager import _manifest_files
+
+    leaves, paths = rdist.read_sharded_state(stepdir)
+    meta = read_meta(stepdir)
+    out_step = os.path.join(os.path.abspath(out_dir), step_dir_name(meta["step"]))
+    state_dir = os.path.join(out_step, "state")
+    # the dist_ckpt numpy-fallback layout (ONE writer for the format):
+    # positional arrays in flatten order + in-payload dtype manifest
+    write_flat_npz(state_dir, leaves,
+                   treedef_note=f"merged:{len(leaves)} leaves")
+    meta = dict(meta, format="checkpoint-v1",
+                merged_from={"dir": os.path.abspath(stepdir),
+                             "hosts": meta.get("hosts")})
+    meta.pop("hosts", None)
+    with open(os.path.join(out_step, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    manifest = {"step": meta["step"], "format": "checkpoint-v1",
+                "files": _manifest_files(out_step)}
+    with open(os.path.join(out_step, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    return out_step
 
 
 def _meta_summary(stepdir: str) -> str:
@@ -72,12 +137,16 @@ def inspect_dir(directory: str, step: int | None = None) -> int:
     print(f"{'step':>10}  {'status':<8} {'size':>10}  summary")
     for s, path in steps:
         ok, problems = validate_step(path)
+        sproblems, ssummary = shard_report(path)
+        ok = ok and not sproblems
+        problems = problems + sproblems
         any_invalid = any_invalid or not ok
         if ok:
             valid.append(s)
         size_mb = _dir_bytes(path) / 1e6
         status = "ok" if ok else "INVALID"
-        print(f"{s:>10}  {status:<8} {size_mb:>8.2f}MB  {_meta_summary(path)}")
+        extra = f"  {ssummary}" if ssummary else ""
+        print(f"{s:>10}  {status:<8} {size_mb:>8.2f}MB  {_meta_summary(path)}{extra}")
         for p in problems:
             print(f"{'':>10}  ! {p}")
         if step is not None and ok:
@@ -93,10 +162,49 @@ def main(argv=None) -> int:
     ap.add_argument("directory", help="CheckpointManager directory")
     ap.add_argument("--step", type=int, default=None,
                     help="inspect one step in full detail")
+    ap.add_argument("--merge", metavar="OUT_DIR", default=None,
+                    help="reassemble a sharded checkpoint into a single-host "
+                         "step dir under OUT_DIR (newest valid step, or the "
+                         "one named by --step)")
     ns = ap.parse_args(argv)
     if not os.path.isdir(ns.directory):
         print(f"error: {ns.directory} is not a directory", file=sys.stderr)
         return 2
+    if ns.merge is not None:
+        steps = list_steps(ns.directory)
+        if ns.step is not None:
+            steps = [(s, p) for s, p in steps if s == ns.step]
+        if not steps:
+            print(f"error: no checkpoint to merge in {ns.directory}",
+                  file=sys.stderr)
+            return 2
+        # newest VALID step (the recovery scenario --merge exists for is
+        # exactly "the newest step dir was damaged in the crash"); an
+        # explicit --step is merged or refused as named
+        chosen = None
+        for s, path in reversed(steps):
+            ok, problems = validate_step(path)
+            sproblems, _ = shard_report(path)
+            if ok and not sproblems:
+                chosen = (s, path)
+                break
+            for p in problems + sproblems:
+                print(f"! step {s}: {p}", file=sys.stderr)
+            print(f"warning: step {s} fails validation; "
+                  + ("refusing to merge it" if ns.step is not None
+                     else "trying an older step"), file=sys.stderr)
+        if chosen is None:
+            print("error: no step passes validation; refusing to merge a "
+                  "damaged checkpoint", file=sys.stderr)
+            return 1
+        s, path = chosen
+        try:
+            out = merge_step(path, ns.merge)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"merged step {s} -> {out}")
+        return 0
     return inspect_dir(ns.directory, ns.step)
 
 

@@ -280,6 +280,50 @@ def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     return lines
 
 
+def checkpoint_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """Checkpoint/robustness section: save/restore traffic, per-host shard
+    counts+bytes (distributed sharded saves), save latency, and the
+    desync / guard-agreement events the distributed fault-tolerance layer
+    emits (docs/robustness.md)."""
+    ckpt_counters = {k: v for k, v in counters.items()
+                     if k.startswith("checkpoint.") or k.startswith("desync.")}
+    dist_guard = {k: v for k, v in counters.items()
+                  if k.startswith("guard.dist_")}
+    shard_evs = [r.get("attrs", {}) for r in recs
+                 if r.get("kind") == "event" and r.get("name") == "checkpoint_shard"]
+    desync_evs = [r for r in recs
+                  if r.get("kind") == "event" and r.get("name") == "desync"]
+    save_ms = sorted(r["attrs"]["ms"] for r in recs
+                     if r.get("kind") == "event"
+                     and r.get("name") == "checkpoint_save"
+                     and (r.get("attrs") or {}).get("phase") == "done"
+                     and "ms" in (r.get("attrs") or {}))
+    if not ckpt_counters and not dist_guard and not shard_evs and not desync_evs:
+        return []
+    lines = []
+    for k, v in sorted({**ckpt_counters, **dist_guard}.items()):
+        lines.append(f"  {k:<28} {v}")
+    if save_ms:
+        n = len(save_ms)
+        # nearest-rank lower median: [5, 500] must report p50=5, not 500 —
+        # an operator triaging save blocking time reads this as "typical"
+        lines.append(f"  ckpt_save_ms                 n={n}  "
+                     f"p50={save_ms[(n - 1) // 2]:.1f}ms  max={save_ms[-1]:.1f}ms")
+    by_host: dict = {}
+    for a in shard_evs:
+        h = a.get("host", "?")
+        cnt, byts, blocks = by_host.get(h, (0, 0, 0))
+        by_host[h] = (cnt + 1, byts + a.get("bytes", 0), blocks + a.get("blocks", 0))
+    for h, (cnt, byts, blocks) in sorted(by_host.items(), key=lambda kv: str(kv[0])):
+        lines.append(f"  host {h!s:<6} shards={cnt:<4} blocks={blocks:<5} "
+                     f"bytes={byts}")
+    for r in desync_evs[-6:]:
+        a = r.get("attrs", {})
+        detail = " ".join(f"{k}={v}" for k, v in sorted(a.items()) if k != "kind")
+        lines.append(f"    @{r['ts_ms']:.0f}ms  DESYNC {a.get('kind', '?'):<12} {detail}")
+    return lines
+
+
 def slo_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     """SLO section: breach counters plus the most recent reason-coded
     slo.breach / slo.recovered events (observability/slo.py)."""
@@ -386,10 +430,14 @@ def render(recs: list[dict], top: int = 0) -> str:
     slo = slo_lines(recs, counters)
     if slo:
         out += ["", "== slo ==", *slo]
+    ckpt = checkpoint_lines(recs, counters)
+    if ckpt:
+        out += ["", "== checkpoint / robustness ==", *ckpt]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.") and not k.startswith("serve.")
              and not k.startswith("slo.breach.") and not k.startswith("artifact.")
-             and not k.startswith("compile.")
+             and not k.startswith("compile.") and not k.startswith("checkpoint.")
+             and not k.startswith("desync.") and not k.startswith("guard.dist_")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]

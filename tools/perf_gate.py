@@ -53,7 +53,12 @@ LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
                 # the cold→warm compile ladder (BENCH_COMPILE.json): the
                 # ratio gates robustly across machines whose absolute cold
                 # compile times differ
-                "warm_over_cold")
+                "warm_over_cold",
+                # blocking time of a checkpoint save (sharded or single-host;
+                # the `ms` of the checkpoint_save done event): distributed
+                # sharded saves must not silently regress what the step loop
+                # pays — the "ms" in the key gives it the latency slack floor
+                "ckpt_save_ms")
 ZERO_TOLERANCE = ("recompiles_steady_state",)
 # keys whose disappearance from the current artifact means the producer
 # broke — the live-range estimator raising, or the artifact store silently
