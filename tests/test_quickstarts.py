@@ -61,7 +61,13 @@ def test_quickstart_multiprocess_resilience():
 
 
 @pytest.mark.analysis
-@pytest.mark.parametrize("script", ["pretrain.py", "continuous_batching.py"])
+@pytest.mark.parametrize("script", [
+    "pretrain.py", "continuous_batching.py",
+    # the fleet quickstart's ONLY smoke is this checked run (it is not in
+    # SCRIPTS above — one subprocess covers both); the serve mark puts the
+    # prefix-sharing + chunk/verify programs in the `pytest -m serve` lane
+    pytest.param("fleet_serving.py", marks=pytest.mark.serve),
+])
 def test_quickstart_runs_with_trace_checking(script):
     """The verifier in the quickstarts' CI path: a training and a serving
     quickstart run end-to-end with pass-interposed checking forced on —

@@ -15,7 +15,8 @@ import pytest
 import thunder_tpu as tt
 from thunder_tpu.inference import GPTInference
 from thunder_tpu.models.litgpt import Config, GPT
-from thunder_tpu.serving import OutOfPages, PageAllocator, PagedKVCache, ServingEngine
+from thunder_tpu.serving import (OutOfPages, PageAllocator, PagedKVCache,
+                                 PrefixCache, ServingEngine)
 from thunder_tpu.serving.runner import bucket_len
 
 pytestmark = pytest.mark.serve
@@ -445,3 +446,251 @@ def test_moe_serving_matches_dense(rng):
     engine.drain()
     out, _ = dense.generate(jnp.asarray(p[None, :]), 5, scan_decode=False)
     np.testing.assert_array_equal(fut.result().new_tokens, np.asarray(out)[0, 8:])
+
+# ---------------------------------------------------------------------------
+# fleet serving: refcounts / CoW, prefix sharing, chunked prefill,
+# speculative decoding, lanes + preemption
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcounts():
+    a = PageAllocator(8)
+    (p,) = a.alloc(1)
+    assert a.refcount(p) == 1
+    a.incref(p)
+    assert a.refcount(p) == 2
+    a.free([p])            # decref: the page must NOT return to the free list
+    assert a.refcount(p) == 1
+    assert a.n_free == 6
+    a.free([p])            # last owner lets go -> released
+    assert a.refcount(p) == 0
+    assert a.n_free == 7
+    with pytest.raises(ValueError, match="double free"):
+        a.free([p])
+    with pytest.raises(ValueError, match="incref"):
+        a.incref(p)        # incref of a free page is a use-after-free
+
+
+def test_shared_page_free_does_not_reissue():
+    """A shared page freed by ONE owner must never be handed to a new
+    allocation while other owners hold it (the double-free-under-sharing
+    hazard the refcount exists to kill)."""
+    a = PageAllocator(4)   # 3 usable
+    pages = a.alloc(3)
+    a.incref(pages[0])     # second owner
+    a.free([pages[0]])     # first owner retires
+    with pytest.raises(OutOfPages):
+        a.alloc(1)         # nothing is actually free
+    a.free(pages)          # remaining owners let go of everything
+    assert a.n_free == 3 and a.n_used == 0
+
+
+def test_cow_fork():
+    a = PageAllocator(8)
+    (p,) = a.alloc(1)
+    assert a.fork(p) == p  # sole owner: write-in-place, no copy
+    a.incref(p)
+    q = a.fork(p)          # shared: detach into a fresh page
+    assert q != p
+    assert a.refcount(p) == 1 and a.refcount(q) == 1
+    with pytest.raises(ValueError, match="fork"):
+        a.fork(7)          # never-allocated page
+
+
+def test_prefix_cache_match_insert_evict():
+    a = PageAllocator(16)
+    c = PrefixCache(a, 4)
+    prompt = np.arange(10, dtype=np.int32)   # 2 full pages + 2-token tail
+    pages = a.alloc(3)
+    assert c.insert(prompt, pages) == 2      # only FULL prompt pages register
+    assert a.refcount(pages[0]) == 2 and a.refcount(pages[2]) == 1
+    shared, covered = c.match(prompt[:8])
+    assert covered == 8 and shared == pages[:2]
+    assert a.refcount(pages[0]) == 3         # match increfs for the caller
+    a.free(shared)
+    # partial tail: a 6-token prompt whose tail is the LEADING tokens of a
+    # cached page is fully covered by sharing that page
+    shared, covered = c.match(prompt[:6])
+    assert covered == 6 and shared == pages[:2]
+    a.free(shared)
+    a.free(pages)                            # original owner retires
+    assert len(c) == 2 and a.n_used == 2     # cache refs keep 2 pages alive
+    assert c.evict_until(15)                 # pool pressure: evict LRU leaves
+    assert len(c) == 0 and a.n_used == 0 and a.n_free == 15
+
+
+def test_prefix_sharing_suffix_prefill_matches_dense(gpt, dense, rng):
+    """Requests sharing a system prompt map the donor's pages and prefill
+    only the unshared suffix; every stream still equals its solo decode."""
+    engine = _engine(gpt, prefix_sharing=True)
+    sys_p = rng.randint(0, gpt.cfg.vocab_size, (16,)).astype(np.int32)  # 2 pages
+    reqs = []
+    for i in range(3):
+        tail = rng.randint(0, gpt.cfg.vocab_size, (3,)).astype(np.int32)
+        p = np.concatenate([sys_p, tail])
+        reqs.append((p, engine.submit(p, max_new_tokens=5, temperature=0.7,
+                                      seed=100 + i)))
+    engine.drain()
+    for p, fut in reqs:
+        out, _ = dense.generate(jnp.asarray(p[None, :]), 5, temperature=0.7,
+                                seed=int(fut.result().request_id) + 100,
+                                scan_decode=False)
+        np.testing.assert_array_equal(fut.result().new_tokens,
+                                      np.asarray(out)[0, len(p):])
+    assert engine.prefix_hits == 2                 # requests 2 and 3
+    assert engine.prefix_tokens_saved == 2 * 16
+
+
+def test_prefix_full_hit_skips_prefill(gpt, dense, rng):
+    """Full coverage (including a partial-tail hit) admits with NO prefill:
+    one re-decoded prompt token recovers the first-token logits."""
+    engine = _engine(gpt, prefix_sharing=True)
+    donor = rng.randint(0, gpt.cfg.vocab_size, (16,)).astype(np.int32)
+    f1 = engine.submit(donor, max_new_tokens=4, seed=7)
+    engine.drain()
+    # exact repeat: both full pages hit
+    f2 = engine.submit(donor, max_new_tokens=4, seed=7)
+    engine.drain()
+    np.testing.assert_array_equal(f1.result().new_tokens, f2.result().new_tokens)
+    assert engine.prefix_hits == 1
+    assert engine.prefix_tokens_saved == 15        # L - 1
+    # partial-tail: an 11-token prefix of the donor is covered by page 2
+    sub = donor[:11]
+    f3 = engine.submit(sub, max_new_tokens=4, temperature=0.5, seed=9)
+    engine.drain()
+    out, _ = dense.generate(jnp.asarray(sub[None, :]), 4, temperature=0.5,
+                            seed=9, scan_decode=False)
+    np.testing.assert_array_equal(f3.result().new_tokens,
+                                  np.asarray(out)[0, 11:])
+    assert engine.prefix_hits == 2
+    # donor pages stay intact (copy-on-write protected them from f2/f3 writes)
+    f4 = engine.submit(donor, max_new_tokens=4, seed=7)
+    engine.drain()
+    np.testing.assert_array_equal(f4.result().new_tokens, f1.result().new_tokens)
+
+
+def test_chunked_prefill_matches_dense(gpt, dense, rng):
+    """Long prompts split into page-aligned chunks interleaved under the
+    token budget produce streams identical to whole-prompt prefill."""
+    engine = _engine(gpt, chunk_tokens=16, prefill_budget=16)
+    shapes = [(40, 5), (23, 4)]   # 16+16+final rung, 16+final (mid-page end)
+    reqs = []
+    for L, n in shapes:
+        p = rng.randint(0, gpt.cfg.vocab_size, (L,)).astype(np.int32)
+        reqs.append((p, n, engine.submit(p, max_new_tokens=n)))
+    engine.drain()
+    for p, n, fut in reqs:
+        out, _ = dense.generate(jnp.asarray(p[None, :]), n, scan_decode=False)
+        np.testing.assert_array_equal(fut.result().new_tokens,
+                                      np.asarray(out)[0, len(p):])
+    assert engine.cache.allocator.n_used == 0      # no sharing -> all returned
+
+
+def test_speculative_random_draft_matches_plain(gpt, dense, rng):
+    """A draft with different weights proposes wrong tokens sometimes; the
+    accept/rollback rule still commits exactly the plain-decode stream."""
+    draft = GPT(Config.from_name("tiny-llama2", block_size=64), dtype=jnp.float32)
+    engine = _engine(gpt, draft_gpt=draft, spec_k=2)
+    p = rng.randint(0, gpt.cfg.vocab_size, (9,)).astype(np.int32)
+    fut = engine.submit(p, max_new_tokens=6)
+    engine.drain()
+    out, _ = dense.generate(jnp.asarray(p[None, :]), 6, scan_decode=False)
+    np.testing.assert_array_equal(fut.result().new_tokens,
+                                  np.asarray(out)[0, 9:])
+    assert engine.spec_proposed > 0
+    assert engine.cache.allocator.n_used == 0
+
+
+def test_all_stages_composed_match_dense(gpt, dense, rng):
+    """Sharing + chunking + speculation all enabled at once: every request
+    still decodes its exact solo stream (the tentpole equivalence bar).
+    The draft IS the target, so this also pins the self-draft ceiling:
+    every proposal must verify."""
+    engine = _engine(gpt, prefix_sharing=True, chunk_tokens=16,
+                     draft_gpt=gpt, spec_k=3)
+    sys_p = rng.randint(0, gpt.cfg.vocab_size, (24,)).astype(np.int32)
+    shapes = [(0, 6, 0.0, 11), (5, 7, 0.8, 12), (9, 4, 0.0, 13), (2, 5, 0.5, 14)]
+    reqs = []
+    for tail_len, n, temp, seed in shapes:
+        tail = rng.randint(0, gpt.cfg.vocab_size, (tail_len,)).astype(np.int32)
+        p = np.concatenate([sys_p, tail]) if tail_len else sys_p.copy()
+        reqs.append((p, n, temp, seed,
+                     engine.submit(p, max_new_tokens=n, temperature=temp,
+                                   seed=seed)))
+        if tail_len == 0:
+            engine.drain()  # warm the prefix cache before the sharers arrive
+    engine.drain()
+    for p, n, temp, seed, fut in reqs:
+        out, _ = dense.generate(jnp.asarray(p[None, :]), n, temperature=temp,
+                                seed=seed, scan_decode=False)
+        np.testing.assert_array_equal(fut.result().new_tokens,
+                                      np.asarray(out)[0, len(p):])
+    assert engine.prefix_hits > 0
+    assert engine.spec_proposed > 0
+    assert engine.spec_accepted == engine.spec_proposed  # perfect draft
+
+
+def test_preemption_spill_resume_identity(gpt, dense, rng):
+    """A batch-lane victim spilled for an interactive admission resumes and
+    finishes with EXACTLY the stream it would have produced unpreempted."""
+    engine = _engine(gpt, n_pages=9)               # 8 usable
+    victim_p = rng.randint(0, gpt.cfg.vocab_size, (9,)).astype(np.int32)
+    victim = engine.submit(victim_p, max_new_tokens=20, lane="batch")
+    engine._step_once()                            # admit + a few tokens
+    engine._step_once()
+    # an interactive request needing the whole pool forces the spill
+    inter_p = rng.randint(0, gpt.cfg.vocab_size, (33,)).astype(np.int32)
+    inter = engine.submit(inter_p, max_new_tokens=5)
+    engine.drain()
+    assert engine.preempted == 1 and engine.resumed == 1
+    out_v, _ = dense.generate(jnp.asarray(victim_p[None, :]), 20,
+                              scan_decode=False)
+    np.testing.assert_array_equal(victim.result().new_tokens,
+                                  np.asarray(out_v)[0, 9:])
+    out_i, _ = dense.generate(jnp.asarray(inter_p[None, :]), 5,
+                              scan_decode=False)
+    np.testing.assert_array_equal(inter.result().new_tokens,
+                                  np.asarray(out_i)[0, 33:])
+    assert engine.cache.allocator.n_used == 0
+
+
+def test_no_leak_with_sharing_under_faults(gpt, rng):
+    """Fault injection with sharing live: a failed suffix prefill must
+    decref (not double-free) its shared pages, and after retirement only
+    the prefix cache's own references remain."""
+    engine = _engine(gpt, prefix_sharing=True)
+    p_shared = rng.randint(0, gpt.cfg.vocab_size, (16,)).astype(np.int32)
+    f1 = engine.submit(p_shared, max_new_tokens=4)
+    engine.drain()
+    f1.result()
+    p2 = np.concatenate([p_shared,
+                         rng.randint(0, gpt.cfg.vocab_size, (5,)).astype(np.int32)])
+    orig = engine.runner.chunk_cfn
+    engine.runner.chunk_cfn = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("injected chunk failure"))
+    f2 = engine.submit(p2, max_new_tokens=4)
+    engine.drain()
+    with pytest.raises(RuntimeError, match="injected"):
+        f2.result(timeout=5)
+    engine.runner.chunk_cfn = orig
+    # the shared pages survived the failure (cache refs intact): retry hits
+    f3 = engine.submit(p2, max_new_tokens=4)
+    engine.drain()
+    f3.result()
+    assert engine.prefix_hits == 2                 # f2 and f3 both matched
+    # only cache-held references remain; eviction returns the pool to empty
+    assert engine.cache.allocator.n_used == len(engine.prefix)
+    engine.prefix.clear()
+    assert engine.cache.allocator.n_used == 0
+    pages = engine.cache.allocator.alloc(engine.cache.n_pages - 1)
+    engine.cache.allocator.free(pages)             # free-list fully consistent
+
+
+def test_lane_validation_and_batch_fifo(gpt, rng):
+    engine = _engine(gpt)
+    p = rng.randint(0, gpt.cfg.vocab_size, (6,)).astype(np.int32)
+    with pytest.raises(ValueError, match="lane"):
+        engine.submit(p, max_new_tokens=2, lane="bulk").result(timeout=5)
+    fut = engine.submit(p, max_new_tokens=3, lane="batch")
+    engine.drain()
+    assert fut.result().n_new_tokens == 3

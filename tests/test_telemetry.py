@@ -238,6 +238,25 @@ class TestOnlineOfflineAgreement:
         # decode-iteration series covers every packed step
         assert hists["serve.decode_ms"]["count"] == engine.decode_steps
 
+    def test_serving_section_splits_lanes(self):
+        """serve_retired events carrying lane= render a per-lane latency
+        breakdown — and single-lane traffic stays aggregate-only."""
+        mod = _load_tool("obs_summary")
+
+        def retired(lane, ttft, tbot):
+            return {"kind": "event", "name": "serve_retired",
+                    "attrs": {"lane": lane, "ttft_ms": ttft, "tbot_ms": tbot,
+                              "n_new": 4}}
+
+        recs = [retired("interactive", 5.0, 1.0), retired("batch", 50.0, 2.0),
+                retired("interactive", 7.0, 1.5)]
+        out = "\n".join(mod.serving_lines(recs, {"serve.retired": 3}))
+        assert re.search(r"lane interactive\s+n=2\s+ttft p50=5\.00", out)
+        assert re.search(r"lane batch\s+n=1\s+ttft p50=50\.00", out)
+        solo = "\n".join(mod.serving_lines(
+            [retired("interactive", 5.0, 1.0)], {"serve.retired": 1}))
+        assert "lane " not in solo
+
     def test_train_step_histogram_counts_every_step(self, obs_mem, rng):
         step, x, y = _train_step(rng)
         for _ in range(6):

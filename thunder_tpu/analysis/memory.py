@@ -87,6 +87,15 @@ def paged_decode_vmem_bytes(page_size: int, D: int, g: int,
     return kv + qb + acc + out
 
 
+def paged_chunk_vmem_bytes(page_size: int, D: int, g: int, T: int,
+                           kv_itemsize: int, q_itemsize: int) -> int:
+    """VMEM working set of the multi-query paged-attention kernel
+    (pallasex `_paged_chunk_kernel`): same page-pair streaming as the decode
+    kernel but the q block, accumulator, and m/l scratch carry g*T rows (T
+    chunk/verify tokens per kv-head group) instead of g."""
+    return paged_decode_vmem_bytes(page_size, D, g * T, kv_itemsize, q_itemsize)
+
+
 def flash_block_cap(widest_itemsize: int, block_q: int, block_k: int,
                     T: int, Tk: int) -> tuple[int, int]:
     """Flash-attention block sizes are swept for bf16; 4-byte operands

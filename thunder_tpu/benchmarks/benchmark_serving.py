@@ -22,6 +22,24 @@ excluded from the TBOT percentiles but still counted in aggregate tokens/s,
 so the row reports ``n_truncated`` explicitly to keep goodput and latency
 denominators honest.
 
+Workloads:
+
+* ``--workload uniform`` (default): every prompt drawn iid from
+  ``[prompt_len_min, prompt_len_max]`` — the original BENCH_SERVE row.
+* ``--workload mixed``: fleet traffic through every serving stage at once
+  (docs/serving.md). A ``--shared_frac`` fraction of requests reuse one
+  system prompt (``--shared_prefix_len`` tokens) plus a short tail —
+  admitted through the copy-on-write prefix cache; a ``--long_frac``
+  fraction carry long prompts on the ``batch`` lane, prefilled in
+  ``--chunk_tokens`` chunks interleaved with decode; the rest are the
+  uniform interactive background. ``--self_draft`` runs the target model
+  as its own speculative draft (every proposal verifies, so the row's
+  ``spec_accept_rate`` is the plumbing ceiling, not a model-quality
+  number). The row adds ``prefix_hit_rate`` (serve.prefix_hits /
+  serve.requests) and ``spec_accept_rate`` (serve.spec_accepted /
+  serve.spec_proposed) from post-warmup counters; both gate
+  higher-is-better in tools/perf_gate.py.
+
 Usage:
     python -m thunder_tpu.benchmarks.benchmark_serving --model_name tiny-llama2 \
         --streams 8 --page_size 16 --arrival_rate 16
@@ -30,6 +48,12 @@ Usage:
     BENCH_SERVE=1 python -m thunder_tpu.benchmarks.benchmark_serving ...
         # additionally writes the BENCH_SERVE.json artifact row
         # (gate fresh runs against it with tools/perf_gate.py)
+    BENCH_SERVE=1 python -m thunder_tpu.benchmarks.benchmark_serving \
+        --mode closed --workload mixed --self_draft --spec_k 2 \
+        --streams 160 --concurrency 10 --precision f32 --n_pages 256 \
+        --slo_ttft_ms 750 --slo_tbot_ms 100 --new_tokens_min 2 \
+        --new_tokens_max 4 --long_frac 0.06 --artifact BENCH_SERVE_FLEET.json
+        # regenerates the committed fleet baseline row
 """
 from __future__ import annotations
 
@@ -46,10 +70,46 @@ import numpy as np
 from thunder_tpu.observability.telemetry import percentile as _pct
 
 
-def _submit(engine, rng, cfg, L, n, temperature):
-    prompt = rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+def _submit(engine, rng, cfg, spec, temperature):
+    prompt, n, lane = spec
     return engine.submit(prompt, max_new_tokens=n, temperature=temperature,
-                         seed=int(rng.randint(1 << 30)))
+                         seed=int(rng.randint(1 << 30)), lane=lane)
+
+
+def _mixed_specs(args, cfg, rng) -> list:
+    """(prompt, max_new_tokens, lane) per stream: shared-prefix requests
+    (interactive), long chunked prompts (batch lane), uniform background."""
+    shared = rng.randint(0, cfg.vocab_size,
+                         (args.shared_prefix_len,)).astype(np.int32)
+    long_max = args.max_seq - args.new_tokens_max - 1
+    specs = []
+    for _ in range(args.streams):
+        n = int(rng.randint(args.new_tokens_min, args.new_tokens_max + 1))
+        u = rng.random_sample()
+        if u < args.shared_frac:
+            tail = rng.randint(0, cfg.vocab_size,
+                               (int(rng.randint(1, 9)),)).astype(np.int32)
+            specs.append((np.concatenate([shared, tail]), n, "interactive"))
+        elif u < args.shared_frac + args.long_frac:
+            L = int(rng.randint(max(args.chunk_tokens + 1, long_max // 2),
+                                long_max + 1))
+            specs.append((rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32),
+                          n, "batch"))
+        else:
+            L = int(rng.randint(args.prompt_len_min, args.prompt_len_max + 1))
+            specs.append((rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32),
+                          n, "interactive"))
+    return specs
+
+
+def _uniform_specs(args, cfg, rng) -> list:
+    return [(rng.randint(0, cfg.vocab_size,
+                         (int(rng.randint(args.prompt_len_min,
+                                          args.prompt_len_max + 1)),)
+                         ).astype(np.int32),
+             int(rng.randint(args.new_tokens_min, args.new_tokens_max + 1)),
+             "interactive")
+            for _ in range(args.streams)]
 
 
 def run(args) -> dict:
@@ -67,19 +127,34 @@ def run(args) -> dict:
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     cfg = Config.from_name(args.model_name, block_size=max(args.max_seq, 128))
     gpt = GPT(cfg, dtype=dtype)
+    fleet_kw = {}
+    if args.workload == "mixed":
+        fleet_kw = dict(prefix_sharing=True, chunk_tokens=args.chunk_tokens,
+                        draft_gpt=gpt if args.self_draft else None,
+                        spec_k=args.spec_k if args.self_draft else None)
     engine = ServingEngine(gpt, max_batch=args.max_batch, page_size=args.page_size,
-                           max_seq=args.max_seq, dtype=dtype, slo=slo)
+                           max_seq=args.max_seq, dtype=dtype, slo=slo,
+                           n_pages=args.n_pages or None, **fleet_kw)
 
     rng = np.random.RandomState(args.seed)
-    lens = [(int(rng.randint(args.prompt_len_min, args.prompt_len_max + 1)),
-             int(rng.randint(args.new_tokens_min, args.new_tokens_max + 1)))
-            for _ in range(args.streams)]
+    if args.workload == "mixed":
+        specs = _mixed_specs(args, cfg, rng)
+    else:
+        specs = _uniform_specs(args, cfg, rng)
 
     observability.enable()
-    # warm every bucket the workload will touch plus the decode step, then
+    # warm every program the workload will touch plus the decode step, then
     # clear the counters: any recompile recorded after this point is a
     # steady-state failure
-    engine.warmup(sorted({L for L, _ in lens}), max_new_tokens=2)
+    if args.workload == "mixed":
+        # replay the full spec list once so every prefill bucket, chunk
+        # rung, and the verify program compile — AND the prefix cache ends
+        # warm, which is the steady state the measured phase models
+        for spec in specs:
+            engine.submit(spec[0], 2, lane=spec[2])
+        engine.drain()
+    else:
+        engine.warmup(sorted({len(p) for p, _, _ in specs}), max_new_tokens=2)
     observability.reset()
     engine.reset_slo_accounting()  # warmup must not pollute goodput/windows
 
@@ -91,20 +166,20 @@ def run(args) -> dict:
             # exponential inter-arrivals -> open-loop schedule (s from t0)
             gaps = rng.exponential(1.0 / args.arrival_rate, size=args.streams)
             arrivals = np.cumsum(gaps) - gaps[0]
-            for (L, n), at in zip(lens, arrivals):
+            for spec, at in zip(specs, arrivals):
                 dt = t0 + float(at) - time.perf_counter()
                 if dt > 0:
                     time.sleep(dt)
-                futs.append(_submit(engine, rng, cfg, L, n, args.temperature))
+                futs.append(_submit(engine, rng, cfg, spec, args.temperature))
             results = [f.result(timeout=600) for f in futs]
         else:
             # closed loop: a fixed number of in-flight requests; every
             # completion immediately feeds the next submission
-            todo = list(lens)
+            todo = list(specs)
             inflight = set()
             while todo and len(inflight) < max(1, args.concurrency):
-                L, n = todo.pop(0)
-                inflight.add(_submit(engine, rng, cfg, L, n, args.temperature))
+                inflight.add(_submit(engine, rng, cfg, todo.pop(0),
+                                     args.temperature))
             futs = list(inflight)
             while inflight:
                 done, inflight = wait(inflight, timeout=600,
@@ -113,8 +188,8 @@ def run(args) -> dict:
                     raise TimeoutError("closed-loop benchmark stalled")
                 for _ in done:
                     if todo:
-                        L, n = todo.pop(0)
-                        f = _submit(engine, rng, cfg, L, n, args.temperature)
+                        f = _submit(engine, rng, cfg, todo.pop(0),
+                                    args.temperature)
                         inflight.add(f)
                         futs.append(f)
             results = [f.result(timeout=600) for f in futs]
@@ -136,10 +211,12 @@ def run(args) -> dict:
     tbots = [r.tbot_s * 1e3 for r in results if r.n_new_tokens > 1]
     n_truncated = sum(1 for r in results if r.n_new_tokens <= 1)
     stats = engine.stats()
+    workload_tag = "" if args.workload == "uniform" else f"{args.workload} workload, "
     row = {
         "platform": jax.devices()[0].platform,
         "metric": (f"{args.model_name} serving aggregate new tokens/sec "
-                   f"({args.streams} {args.mode}-loop streams, max_batch={args.max_batch}, "
+                   f"({args.streams} {args.mode}-loop streams, {workload_tag}"
+                   f"max_batch={args.max_batch}, "
                    f"page_size={args.page_size}, "
                    f"prompts {args.prompt_len_min}-{args.prompt_len_max}, "
                    f"outputs {args.new_tokens_min}-{args.new_tokens_max})"),
@@ -160,6 +237,22 @@ def run(args) -> dict:
         "recompiles_steady_state": int(recompiles),
         "serve_counters": {k: v for k, v in counters.items() if k.startswith("serve.")},
     }
+    if args.workload == "mixed":
+        n_req = counters.get("serve.requests", 0)
+        proposed = counters.get("serve.spec_proposed", 0)
+        row["workload"] = {"shared_frac": args.shared_frac,
+                           "long_frac": args.long_frac,
+                           "shared_prefix_len": args.shared_prefix_len,
+                           "chunk_tokens": args.chunk_tokens,
+                           "self_draft": bool(args.self_draft),
+                           "spec_k": args.spec_k if args.self_draft else 0}
+        row["prefix_hit_rate"] = (round(counters.get("serve.prefix_hits", 0)
+                                        / n_req, 4) if n_req else None)
+        row["prefix_tokens_saved"] = counters.get("serve.prefix_tokens_saved", 0)
+        row["spec_accept_rate"] = (round(counters.get("serve.spec_accepted", 0)
+                                         / proposed, 4) if proposed else None)
+        row["preempted"] = stats["preempted"]
+        row["resumed"] = stats["resumed"]
     if slo is not None:
         n_met = sum(1 for r in results if r.slo_met)
         row["slo"] = {"ttft_ms": args.slo_ttft_ms or None,
@@ -196,6 +289,21 @@ def main():
                    help="per-request TTFT target; enables goodput reporting")
     p.add_argument("--slo_tbot_ms", type=float, default=0.0,
                    help="per-request TBOT target; enables goodput reporting")
+    p.add_argument("--workload", default="uniform", choices=["uniform", "mixed"])
+    p.add_argument("--shared_frac", type=float, default=0.6,
+                   help="mixed: fraction of requests sharing the system prompt")
+    p.add_argument("--long_frac", type=float, default=0.15,
+                   help="mixed: fraction with long (chunk-prefilled) prompts")
+    p.add_argument("--shared_prefix_len", type=int, default=64,
+                   help="mixed: shared system-prompt length (page-aligned)")
+    p.add_argument("--chunk_tokens", type=int, default=64,
+                   help="mixed: chunked-prefill chunk size")
+    p.add_argument("--self_draft", action="store_true",
+                   help="mixed: speculative decoding with the target as its "
+                        "own draft (plumbing-ceiling accept rate)")
+    p.add_argument("--spec_k", type=int, default=3)
+    p.add_argument("--n_pages", type=int, default=0,
+                   help="page-pool override (0 = engine default)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--seed", type=int, default=0)

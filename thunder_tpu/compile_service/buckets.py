@@ -85,6 +85,18 @@ class BucketLadder:
         share the id, so they share the stored artifact."""
         return self._rungs.index(self.bucket_for(n))
 
+    def subladder(self, max_len: int) -> "BucketLadder":
+        """A ladder with the same min rung and page alignment but a lower
+        cap — the serving engine's chunked prefill rounds its FINAL chunk
+        with ``subladder(chunk_tokens)`` so chunk programs specialize over
+        strictly fewer rungs than whole-prompt prefill. Traffic stats are
+        NOT shared: the child tracks its own hits/MRU."""
+        if not (self.min_len <= max_len <= self.max_len):
+            raise ValueError(
+                f"subladder max_len={max_len} must lie within "
+                f"[{self.min_len}, {self.max_len}]")
+        return BucketLadder(self.min_len, max_len, page_size=self.page_size)
+
     def __contains__(self, n: int) -> bool:
         return n in self._rungs
 

@@ -1676,3 +1676,148 @@ def _paged_attention_impl(q, k_pages, v_pages, page_table, seq_lens, scale=None)
 
 ex.register_implementation("thunder.paged_attention", _paged_attention_impl,
                            checker=paged_attention_supported)
+
+
+# ---------------------------------------------------------------------------
+# Paged attention — multi-query (chunked prefill + speculative verify)
+# ---------------------------------------------------------------------------
+#
+# The fleet-serving programs attend MORE than one new token per sequence
+# against the same paged pool: a chunked-prefill chunk (B=1, T=chunk tokens)
+# and the speculative-decoding verify step (T=k+1 proposals per packed
+# sequence), both with PER-QUERY causal coverage k_pos <= q_pos[b, t]. The
+# kernel is the decode kernel with the q group widened to (g*T, D) and the
+# per-query positions riding as a third scalar-prefetch operand for the
+# masking. Shared (copy-on-write) page tables are transparent: a physical
+# page shared by N sequences simply appears in N table rows, and partial
+# chunk tables (entries past the written prefix) point at the null page,
+# which the q_pos mask keeps out of the accumulators either way.
+
+
+def _paged_chunk_kernel(pt_ref, sl_ref, qp_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc_scr, m_scr, l_scr, *, page_size: int, n_q: int,
+                        scale: float):
+    # grid (B, Hkv, n_pages_max); q_ref (g*T, D) — T queries per kv head
+    # group, flattened into rows; qp_ref carries each query's absolute
+    # position ((B, T) prefetched), sl_ref the per-sequence page coverage
+    # bound (max q_pos + 1) used to skip trailing never-attended pages.
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+    gT, D = q_ref.shape
+    g = gT // n_q
+
+    @pl.when(p == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(p * page_size < sl_ref[b])
+    def _compute():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * (scale * LOG2E)
+        k_pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (gT, page_size), 1)
+        # row r of the flattened q block is query t = r % n_q of its group
+        t_of_row = jax.lax.broadcasted_iota(jnp.int32, (gT, page_size), 0) % n_q
+        q_pos = qp_ref[b, t_of_row]
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:][:, 0]
+        l_prev = l_scr[:][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        pexp = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m_prev - m_new)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new[:, None]
+        l_scr[:] = (l_prev * corr + jnp.sum(pexp, axis=1))[:, None]
+
+    @pl.when(p == n_p - 1)
+    def _write():
+        l = l_scr[:][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_chunk_decode(q, k_pages, v_pages, page_table, q_pos, scale=None,
+                       *, interpret: bool | None = None):
+    """q (B, H, T, D) against a paged pool (P, page_size, Hkv, D) through
+    page_table (B, n_pages_max) with per-query positions q_pos (B, T) int32
+    -> (B, H, T, D). Each query attends key positions <= its own."""
+    B, H, T, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    npm = page_table.shape[1]
+    g = H // Hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    # (B, Hkv, g*T, D): group rows of one kv head, T queries per group row set
+    qg = q.reshape(B, Hkv, g, T, D).reshape(B, Hkv, g * T, D)
+    seq_lens = jnp.max(q_pos, axis=1) + 1  # page coverage bound per sequence
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Hkv, npm),
+        in_specs=[
+            pl.BlockSpec((None, None, g * T, D), lambda b, h, p, pt, sl, qp: (b, h, 0, 0)),
+            pl.BlockSpec((None, ps, None, D), lambda b, h, p, pt, sl, qp: (pt[b, p], 0, h, 0)),
+            pl.BlockSpec((None, ps, None, D), lambda b, h, p, pt, sl, qp: (pt[b, p], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, g * T, D),
+                               lambda b, h, p, pt, sl, qp: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((g * T, D), jnp.float32),
+                        pltpu.VMEM((g * T, 1), jnp.float32),
+                        pltpu.VMEM((g * T, 1), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_chunk_kernel, page_size=ps, n_q=T, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g * T, D), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_pos.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(B, Hkv, g, T, D).reshape(B, H, T, D)
+
+
+def paged_chunk_attention_supported(q, k_pages, v_pages, page_table, q_pos,
+                                    scale=None) -> bool:
+    """Checker for thunder.paged_chunk_attention: same claim policy as the
+    decode kernel (TT_PAGED_KERNEL override, page tiling, VMEM budget with
+    the q/accumulator rows widened by T)."""
+    if pltpu is None:
+        return False
+    override = os.environ.get("TT_PAGED_KERNEL")
+    if override == "0":
+        return False
+    if not (_on_tpu() or override == "1"):
+        return False
+    if getattr(q, "ndim", 0) != 4 or getattr(k_pages, "ndim", 0) != 4:
+        return False
+    B, H, T, D = q.shape
+    P, ps, Hkv, Dk = k_pages.shape
+    shapes_ok = (
+        D == Dk and D <= 512
+        and tuple(v_pages.shape) == tuple(k_pages.shape)
+        and H % Hkv == 0
+        and ps % 8 == 0  # sublane tile
+        and getattr(page_table, "ndim", 0) == 2 and page_table.shape[0] == B
+        and getattr(q_pos, "ndim", 0) == 2 and tuple(q_pos.shape) == (B, T)
+    )
+    if not shapes_ok:
+        return False
+    from ..analysis import budget as _budget
+
+    kv_item = jnp.dtype(str(k_pages.dtype).rpartition(".")[2]).itemsize
+    q_item = jnp.dtype(str(q.dtype).rpartition(".")[2]).itemsize
+    return _budget.within_vmem(
+        _budget.paged_chunk_vmem_bytes(ps, D, H // Hkv, T, kv_item, q_item),
+        _budget.paged_vmem_limit())
+
+
+def _paged_chunk_attention_impl(q, k_pages, v_pages, page_table, q_pos, scale=None):
+    return paged_chunk_decode(q, k_pages, v_pages, page_table, q_pos, scale)
+
+
+ex.register_implementation("thunder.paged_chunk_attention", _paged_chunk_attention_impl,
+                           checker=paged_chunk_attention_supported)

@@ -224,7 +224,16 @@ def record_serve(outcome: str, delta: int = 1, event: bool = False, **attrs) -> 
     ``serve_<outcome>`` timeline event carrying the request tags
     (request id, ttft_ms/tbot_ms, pool_utilization). High-rate outcomes
     (decode_steps, tokens) stay counter-only so a long-running engine
-    doesn't flood the ring buffer."""
+    doesn't flood the ring buffer.
+
+    Fleet-serving vocabulary (docs/serving.md; all zero-work when
+    observability is disabled, like every outcome here): ``prefix_hits`` /
+    ``prefix_tokens_saved`` (copy-on-write prefix cache), ``spec_proposed``
+    / ``spec_accepted`` (speculative draft tokens offered / verified —
+    their ratio is the accept rate perf_gate.py gates), and the
+    lane-scheduling events ``preempted`` / ``resumed``. ``serve_retired``
+    events carry ``lane=`` so obs_summary.py can split latency percentiles
+    per lane."""
     if not events.enabled():
         return
     events.inc(f"serve.{outcome}", delta)

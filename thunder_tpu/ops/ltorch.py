@@ -946,6 +946,53 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None):
     return reshape(prims.matmul(probs, v), (B, H, D))
 
 
+@torchsymbol(name="paged_chunk_attention", id="thunder.paged_chunk_attention")
+def paged_chunk_attention(q, k_pages, v_pages, page_table, q_pos, scale=None):
+    """Multi-query paged attention: T new tokens per sequence attend the
+    block-paged pool with PER-QUERY causal coverage (k_pos <= q_pos[b, t]).
+
+    q            (B, H, T, D)        — T new tokens' query heads per sequence
+    k_pages/v_pages (P, page_size, Hkv, D) — the shared per-layer page pool
+    page_table   (B, n_pages_max) int — per-sequence page ids; entries beyond
+                 the sequence's pages point at the reserved null page 0
+    q_pos        (B, T) int          — each query's ABSOLUTE position; it
+                 attends keys at positions <= its own (whose k/v, including
+                 its own token's, are already written to their pages)
+
+    One symbol serves both new paged multi-token programs (serving/runner.py):
+    the CHUNKED-PREFILL chunk (B=1, T=chunk tokens, positions start..start+T)
+    and the SPECULATIVE-DECODING verify step (T=k+1 proposed tokens per
+    packed sequence). Shared (copy-on-write) page tables need nothing
+    special here — shared pages simply repeat across rows of `page_table`.
+    This decomposition is the pure-jax gather reference path; the pallas
+    executor claims the symbol whole on TPU with a q_pos-prefetch variant of
+    the paged decode kernel (executors/pallasex.py:paged_chunk_decode)."""
+    B, H, T, D = q.shape
+    P, ps, Hkv, _ = k_pages.shape
+    npm = page_table.shape[1]
+    S = npm * ps
+    check(H % Hkv == 0,
+          lambda: f"paged_chunk_attention: q heads {H} not divisible by kv heads {Hkv}")
+    check(tuple(q_pos.shape) == (B, T),
+          lambda: f"paged_chunk_attention: q_pos {q_pos.shape} must be (B, T)=({B}, {T})")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    flat = reshape(page_table, (B * npm,))
+    k = clang.take(k_pages, flat, 0)  # (B*npm, ps, Hkv, D)
+    v = clang.take(v_pages, flat, 0)
+    k = permute(reshape(k, (B, S, Hkv, D)), (0, 2, 1, 3))  # (B, Hkv, S, D)
+    v = permute(reshape(v, (B, S, Hkv, D)), (0, 2, 1, 3))
+    if H != Hkv:
+        k = repeat_interleave(k, H // Hkv, 1)
+        v = repeat_interleave(v, H // Hkv, 1)
+    scores = clang.mul(prims.matmul(q, clang.matrix_transpose(k)), scale)  # (B, H, T, S)
+    k_pos = reshape(prims.iota(S, dtype=dtypes.int32, device=q.device), (1, 1, 1, S))
+    live = clang.le(k_pos, reshape(q_pos, (B, 1, T, 1)))
+    scores = clang.where(live, scores, float("-inf"))
+    probs = softmax(scores, -1)
+    probs = clang.maybe_convert_to_dtype(probs, v.dtype)
+    return prims.matmul(probs, v)  # (B, H, T, D)
+
+
 @torchsymbol(name="cross_entropy", id="torch.nn.functional.cross_entropy")
 def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
     """Composite cross-entropy over class dim 1 / last for 2D (logits (N, C)).

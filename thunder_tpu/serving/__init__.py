@@ -10,14 +10,21 @@ that admits, decodes, and retires requests every iteration (scheduler.py).
     engine = ServingEngine(gpt, max_batch=8, page_size=16, max_seq=256)
     fut = engine.submit(prompt_ids, max_new_tokens=32)
     result = fut.result()      # result.tokens, result.ttft_s, result.tbot_s
+
+Fleet-serving stages (docs/serving.md) layer on the same engine: refcounted
+copy-on-write prefix sharing (PrefixCache), chunked prefill, speculative
+decoding via a draft model, and SLO-aware interactive/batch lanes with
+preemption.
 """
-from .kv_pages import OutOfPages, PageAllocator, PagedKVCache
+from .kv_pages import NULL_PAGE, OutOfPages, PageAllocator, PagedKVCache, PrefixCache
 from .scheduler import RequestResult, ServingEngine
 
 __all__ = [
+    "NULL_PAGE",
     "OutOfPages",
     "PageAllocator",
     "PagedKVCache",
+    "PrefixCache",
     "RequestResult",
     "ServingEngine",
 ]

@@ -15,6 +15,31 @@ The Orca (OSDI '22) iteration-level scheduling loop over the paged KV pool:
   over the page pool and retires finished sequences, returning their pages
   to the free-list immediately.
 
+Four throughput stages compose on top of that loop, each OFF by default so
+the baseline engine behaves exactly as before (docs/serving.md):
+
+* prefix sharing (``prefix_sharing=True``) — admission consults a
+  content-keyed ``PrefixCache`` and maps already-cached prompt pages into
+  the new request's table (refcounted, copy-on-write on first divergence);
+  prefill then runs only on the unshared suffix, and a fully covered
+  prompt skips prefill entirely (one re-decoded token recovers the
+  first-token logits bit-identically).
+* chunked prefill (``chunk_tokens=N``) — prompts longer than N are split
+  into page-aligned chunks interleaved into decode iterations under a
+  ``prefill_budget`` tokens-per-iteration cap, bounding the decode-latency
+  spike a long prompt used to inject.
+* speculative decoding (``draft_gpt=...``) — a small draft model proposes
+  ``spec_k`` tokens per iteration with the SAME position-keyed sampler;
+  one packed target verify step scores all k+1 positions and the accepted
+  prefix (capped at k — no bonus token, which keeps the draft KV valid)
+  commits. Accepted tokens are bit-identical to plain decode.
+* SLO-aware lanes (``submit(..., lane="batch")``) — interactive requests
+  admit first; under page pressure or SLO burn the engine preempts batch
+  sequences (pages spilled, request requeued at the front of the batch
+  lane) and re-prefills them on resume — cheap when prefix sharing holds
+  their pages in cache, and bit-identical thanks to position-keyed
+  sampling.
+
 Per-request observability rides the existing bus: request-id-tagged spans,
 ``serve.*`` counters, and flight-recorder records per decode iteration
 (docs/serving.md, docs/observability.md).
@@ -31,7 +56,7 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +69,7 @@ from ..observability import metrics as _obs_metrics
 from ..observability import runtime as _obs_runtime
 from ..observability import telemetry as _obs_tel
 from ..observability.slo import SLOMonitor, SLOPolicy
-from .kv_pages import PagedKVCache
+from .kv_pages import PagedKVCache, PrefixCache
 from .runner import PagedGPTRunner
 
 _NULL = contextlib.nullcontext()
@@ -76,11 +101,20 @@ class _Request:
     eos_id: Optional[int]
     future: Future
     t_submit: float
+    lane: str = "interactive"
     t_first: float = 0.0
     t_last: float = 0.0
     tokens: List[int] = field(default_factory=list)
     pages: List[int] = field(default_factory=list)
     bucket: int = 0
+    # admission-time routing state (set by _reserve_pages each admission —
+    # a preempted request is re-routed from scratch on resume)
+    admit_seq: int = -1          # monotone admission order (preemption victims)
+    admit_mode: str = ""         # "prefill" | "chunk" | "hit"
+    prompt_eff: Optional[np.ndarray] = None  # prompt (+ committed tokens on resume)
+    covered: int = 0             # prefix-cache token coverage of prompt_eff
+    n_shared: int = 0            # leading shared pages in .pages
+    chunk_pos: int = -1          # next chunk start (chunk mode only)
 
 
 def _sample_tokens(logits, seeds, pos, temps):
@@ -104,12 +138,30 @@ class ServingEngine:
     n_pages     pool size per layer (default: full residency for max_batch
                 sequences of max_seq tokens, plus the reserved null page)
     max_seq     per-sequence length cap (prompt + generated)
+
+    Throughput stages (all off by default; see the module docstring):
+
+    prefix_sharing  consult/populate a content-keyed PrefixCache at admission
+    chunk_tokens    split prompts longer than this into page-aligned chunks
+                    (default max_seq: whole-prompt prefill, never chunked
+                    unless a prefix hit leaves an unaligned-free suffix)
+    prefill_budget  chunk-prefill tokens per engine iteration (default
+                    chunk_tokens: one chunk per iteration)
+    draft_gpt       draft model for speculative decoding (same vocab; its
+                    KV pool shares the target allocator page-for-page)
+    spec_k          draft tokens proposed per iteration (default 4 with a
+                    draft, 0 without)
+    preemption      allow spilling batch-lane sequences for interactive
+                    admission / SLO burn (on; only bites with lanes in use)
     """
 
     def __init__(self, gpt, *, max_batch: int = 8, page_size: int = 16,
                  n_pages: Optional[int] = None, max_seq: Optional[int] = None,
                  dtype=jnp.bfloat16, min_bucket: Optional[int] = None,
-                 slo: Optional[SLOPolicy] = None):
+                 slo: Optional[SLOPolicy] = None, prefix_sharing: bool = False,
+                 chunk_tokens: Optional[int] = None,
+                 prefill_budget: Optional[int] = None, draft_gpt=None,
+                 spec_k: Optional[int] = None, preemption: bool = True):
         cfg = gpt.cfg
         self.gpt = gpt
         self.cfg = cfg
@@ -137,11 +189,62 @@ class ServingEngine:
                                    page_size=page_size)
         self.dtype = dtype
 
+        if chunk_tokens is None:
+            chunk_tokens = self.max_seq
+        if chunk_tokens % page_size or not (self.min_bucket <= chunk_tokens
+                                            <= self.max_seq):
+            raise ValueError(
+                f"chunk_tokens={chunk_tokens} must be a page-aligned length "
+                f"in [{self.min_bucket}, {self.max_seq}]")
+        self.chunk_tokens = chunk_tokens
+        # final (short) chunks round on a capped child of the SAME ladder,
+        # so chunk programs specialize over strictly fewer rungs
+        self.chunk_ladder = self.ladder.subladder(chunk_tokens)
+        self.prefill_budget = prefill_budget or chunk_tokens
+        if self.prefill_budget < page_size:
+            raise ValueError(f"prefill_budget={self.prefill_budget} must be "
+                             f">= page_size={page_size}")
+        self.preemption = preemption
+
         self.cache = PagedKVCache(cfg.n_layer, n_pages, page_size,
                                   cfg.n_query_groups, cfg.head_size, dtype)
         self.runner = PagedGPTRunner(gpt, page_size=page_size)
         self.params = {k: p.data for k, p in gpt.named_parameters()}
         self._sampler = jax.jit(_sample_tokens)
+
+        self.prefix = (PrefixCache(self.cache.allocator, page_size)
+                       if prefix_sharing else None)
+
+        self.draft_gpt = draft_gpt
+        self.spec_k = (int(spec_k) if spec_k is not None
+                       else (4 if draft_gpt is not None else 0))
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k={self.spec_k} must be >= 0")
+        if self.spec_k and draft_gpt is None:
+            raise ValueError("spec_k > 0 requires a draft_gpt")
+        if draft_gpt is not None:
+            dcfg = draft_gpt.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size={dcfg.vocab_size} != target "
+                    f"vocab_size={cfg.vocab_size}")
+            if draft_gpt.cos.shape[0] < self.max_seq:
+                raise ValueError(
+                    f"draft rope cache ({draft_gpt.cos.shape[0]} positions) "
+                    f"shorter than max_seq={self.max_seq}")
+            # the draft pool SHARES the target allocator: one allocation and
+            # one page table cover both models, so sharing/CoW/preemption
+            # bookkeeping never runs twice
+            self.draft_cache = PagedKVCache(
+                dcfg.n_layer, n_pages, page_size, dcfg.n_query_groups,
+                dcfg.head_size, dtype, allocator=self.cache.allocator)
+            self.draft_runner = PagedGPTRunner(draft_gpt, page_size=page_size)
+            self.draft_params = {k: p.data
+                                 for k, p in draft_gpt.named_parameters()}
+        else:
+            self.draft_cache = None
+            self.draft_runner = None
+            self.draft_params = None
 
         # host-side packed decode state; pos/toks change every step and are
         # re-uploaded, while seeds/temps/page tables only change at
@@ -157,7 +260,10 @@ class ServingEngine:
         self._pt_dirty = True
         self._slots: List[Optional[_Request]] = [None] * max_batch
 
-        self._pending: deque = deque()
+        self._pending: deque = deque()        # interactive lane (admits first)
+        self._pending_batch: deque = deque()  # batch lane (preemptible)
+        self._chunking: Dict[int, _Request] = {}  # slot -> mid-chunk-prefill
+        self._admit_counter = 0
         self._lock = threading.Lock()
         self._next_id = 0
         # submitted-but-unresolved count: _has_work()/drain() key off this
@@ -170,6 +276,14 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self.decode_steps = 0
         self.peak_pages_in_use = 0
+        # stage counters (host truth; mirrored onto the serve.* bus when
+        # observability is on — benchmark rates derive from the bus copies)
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.preempted = 0
+        self.resumed = 0
 
         # SLO measurement substrate (observability/slo.py): a declarative
         # policy gets a sliding-window monitor (breach events/counters) and
@@ -183,14 +297,22 @@ class ServingEngine:
 
     # -- public API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
-               seed: Optional[int] = None, eos_id: Optional[int] = None) -> Future:
+               seed: Optional[int] = None, eos_id: Optional[int] = None,
+               lane: str = "interactive") -> Future:
         """Enqueue one generation request; thread-safe. The Future resolves
-        to a RequestResult (or a ValueError for an inadmissible request)."""
+        to a RequestResult (or a ValueError for an inadmissible request).
+        lane="interactive" admits ahead of lane="batch"; batch sequences may
+        be preempted (spilled and later resumed, stream unchanged) when an
+        interactive request is page-starved or the SLO budget is burning."""
         fut: Future = Future()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
+        if lane not in ("interactive", "batch"):
+            fut.set_exception(ValueError(
+                f"request {rid}: lane={lane!r} must be 'interactive' or 'batch'"))
+            return fut
         L = int(prompt.shape[0])
         worst = self._pages_needed(L, max_new_tokens)
         usable = self.cache.n_pages - 1
@@ -208,14 +330,15 @@ class ServingEngine:
         # solo-vs-batched stream equivalence for any Python int seed
         req = _Request(rid, prompt, max_new_tokens, float(temperature),
                        int(seed if seed is not None else rid) & 0xFFFFFFFF,
-                       eos_id, fut, time.perf_counter())
+                       eos_id, fut, time.perf_counter(), lane=lane)
         with self._lock:
             if self._stopped:
                 # stop() already flushed the queue; a late submit must fail
                 # loudly rather than enqueue a Future nothing will resolve
                 fut.set_exception(RuntimeError("serving engine stopped"))
                 return fut
-            self._pending.append(req)
+            (self._pending if lane == "interactive"
+             else self._pending_batch).append(req)
             self._outstanding += 1
         if _obs.enabled():
             _obs_metrics.record_serve("requests")
@@ -250,12 +373,17 @@ class ServingEngine:
             if req is not None:
                 self._fail(req, exc)
                 self._clear_slot(i)
+        for req in list(self._chunking.values()):
+            self._fail(req, exc)
+        self._chunking.clear()
         with self._lock:
             # flag + flush under ONE lock section: a racing submit either
             # lands before the flush (failed here) or sees _stopped and
             # fails itself — no window leaves an unresolvable Future
             self._stopped = True
-            pending, self._pending = list(self._pending), deque()
+            pending = list(self._pending) + list(self._pending_batch)
+            self._pending = deque()
+            self._pending_batch = deque()
         for req in pending:
             self._fail(req, exc)
 
@@ -286,11 +414,20 @@ class ServingEngine:
             "peak_page_pool_utilization": round(self.peak_pages_in_use / usable, 4)
             if usable else 0.0,
             "active": sum(1 for s in self._slots if s is not None),
-            "pending": len(self._pending),
+            "pending": len(self._pending) + len(self._pending_batch),
+            "chunking": len(self._chunking),
             "decode_steps": self.decode_steps,
             "prefill_buckets": self.ladder.mru(),
             "bucket_hits": self.ladder.hits(),
+            "prefix_hits": self.prefix_hits,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "preempted": self.preempted,
+            "resumed": self.resumed,
         }
+        if self.prefix is not None:
+            out["prefix_cache_pages"] = len(self.prefix)
         if self.slo_policy is not None:
             out["requests_retired"] = self.requests_retired
             out["requests_slo_met"] = self.requests_slo_met
@@ -350,31 +487,209 @@ class ServingEngine:
                    PagedKVCache.pages_for(L + max_new, self.page_size))
 
     def _step_once(self) -> None:
+        self._maybe_preempt_for_slo()
         self._admit()
+        self._advance_prefills()
         self._decode()
 
     def _admit(self) -> None:
         while True:
-            free_slots = [i for i, s in enumerate(self._slots) if s is None]
+            free_slots = [i for i, s in enumerate(self._slots)
+                          if s is None and i not in self._chunking]
             if not free_slots:
                 return
+            req = queue = None
             with self._lock:
-                if not self._pending:
-                    return
-                req = self._pending[0]
-                if req.future.cancelled():
-                    # cancelled while queued: drop before allocating anything
-                    self._pending.popleft()
-                    self._outstanding -= 1
+                for q in (self._pending, self._pending_batch):
+                    while q and q[0].future.cancelled():
+                        # cancelled while queued: drop before allocating
+                        # anything (a preempted victim's pages were already
+                        # spilled, so there is nothing to return either)
+                        q.popleft()
+                        self._outstanding -= 1
+                    if req is None and q:
+                        req, queue = q[0], q
+            if req is None:
+                return
+            if not self._reserve_pages(req):
+                # head-of-line within the lane pair: interactive starvation
+                # may evict batch-lane victims; otherwise wait for retirements
+                if (req.lane == "interactive" and self.preemption
+                        and self._preempt_one()):
                     continue
-                need = self._pages_needed(len(req.prompt), req.max_new_tokens)
-                if not self.cache.allocator.can_alloc(need):
-                    return  # FIFO head-of-line: wait for retirements
-                self._pending.popleft()
-            req.pages = self.cache.allocator.alloc(need)
+                return
+            with self._lock:
+                queue.popleft()
             self.peak_pages_in_use = max(self.peak_pages_in_use,
                                          self.cache.allocator.n_used)
-            self._prefill(req, free_slots[0])
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            if req.admit_mode == "hit":
+                self._admit_hit(req, free_slots[0])
+            elif req.admit_mode == "chunk":
+                self._start_chunk(req, free_slots[0])
+            else:
+                self._prefill(req, free_slots[0])
+
+    def _reserve_pages(self, req: _Request) -> bool:
+        """Route one request (prefix hit / chunked / whole-prompt prefill)
+        and reserve its worst-case pages: shared pages come from the prefix
+        cache (already incref'd by match), private ones from the free-list.
+        On shortage every side effect is undone and False is returned — the
+        request stays at its queue head."""
+        ps = self.page_size
+        resumed = bool(req.tokens)
+        # a resumed victim re-prefills prompt + all-but-the-last committed
+        # token: the last one re-enters decode exactly where the spill cut it
+        prompt_eff = (req.prompt if not resumed else
+                      np.concatenate([req.prompt,
+                                      np.asarray(req.tokens[:-1], np.int32)]))
+        L_eff = len(prompt_eff)
+        lifetime = PagedKVCache.pages_for(
+            len(req.prompt) + req.max_new_tokens, ps)
+        shared: List[int] = []
+        covered = 0
+        if self.prefix is not None:
+            shared, covered = self.prefix.match(prompt_eff)
+        n_shared = len(shared)
+        if covered == L_eff and n_shared:
+            # full coverage: no prefill at all. The first decode step
+            # re-writes position L_eff-1 (the copy-on-write trigger) and
+            # recovers the first-token logits bit-identically; a resumed
+            # victim needs no logits, only a CoW fork if its next write
+            # lands in the shared tail page.
+            fork_n = 0 if (resumed and L_eff % ps == 0) else 1
+            priv = lifetime - n_shared
+            mode = "hit"
+        elif covered > 0 or L_eff > self.chunk_tokens:
+            end = self._final_chunk_end(L_eff, covered)
+            priv = max(lifetime, end // ps) - n_shared
+            fork_n = 0
+            mode = "chunk"
+        else:
+            priv = max(lifetime, self.ladder.bucket_for(L_eff) // ps)
+            fork_n = 0
+            mode = "prefill"
+        need = priv + fork_n
+        if not self.cache.allocator.can_alloc(need):
+            # cache-only pages are reclaimable: evicting them drops the
+            # cache's reference, never a live sequence's (or ours — the
+            # matched pages above hold our incref and survive eviction)
+            if self.prefix is not None:
+                self.prefix.evict_until(need)
+            if not self.cache.allocator.can_alloc(need):
+                if shared:
+                    self.cache.allocator.free(shared)
+                return False
+        req.prompt_eff = prompt_eff
+        req.covered = covered
+        req.n_shared = n_shared
+        req.admit_mode = mode
+        req.pages = shared + (self.cache.allocator.alloc(priv) if priv else [])
+        return True
+
+    def _final_chunk_end(self, L_eff: int, covered: int) -> int:
+        """Absolute end of the final chunk's page write-out: intermediate
+        chunks are exactly chunk_tokens, the final one rounds up on the
+        capped chunk ladder — unless that rung would cross max_seq (and so
+        the rope table), in which case it falls back to the exact page-
+        aligned remainder."""
+        C = self.chunk_tokens
+        s = covered + ((L_eff - covered - 1) // C) * C
+        rung = self.chunk_ladder.bucket_for(L_eff - s)
+        if s + rung > self.max_seq:
+            rung = PagedKVCache.pages_for(L_eff - s, self.page_size) * self.page_size
+        return s + rung
+
+    def _admit_hit(self, req: _Request, slot: int) -> None:
+        """Admit a fully prefix-covered request without running prefill."""
+        ps = self.page_size
+        resumed = bool(req.tokens)
+        L_eff = len(req.prompt_eff)
+        if not (resumed and L_eff % ps == 0):
+            # the first write (position L_eff-1 fresh, L_eff resumed) lands
+            # in the last shared page: detach it now. fork() only pays the
+            # device copy when other owners remain.
+            old = req.pages[req.n_shared - 1]
+            new = self.cache.allocator.fork(old)
+            if new != old:
+                self.cache.copy_page(old, new)
+                if self.draft_cache is not None:
+                    self.draft_cache.copy_page(old, new)
+                req.pages[req.n_shared - 1] = new
+        saved = L_eff if resumed else L_eff - 1
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += saved
+        if _obs.enabled():
+            _obs_metrics.record_serve("prefix_hits")
+            _obs_metrics.record_serve("prefix_tokens_saved", delta=saved)
+        if resumed:
+            self._on_resume(req)
+            self._activate(req, slot, pos=L_eff, tok=req.tokens[-1])
+        else:
+            # t_first stays 0.0: TTFT is stamped when the first token
+            # commits in decode (the re-decoded prompt token is not output)
+            self._activate(req, slot, pos=L_eff - 1,
+                           tok=int(req.prompt_eff[-1]))
+
+    def _start_chunk(self, req: _Request, slot: int) -> None:
+        """Reserve a slot for chunked prefill; chunks run under the
+        per-iteration token budget in _advance_prefills."""
+        req.chunk_pos = req.covered
+        if req.covered:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += req.covered
+            if _obs.enabled():
+                _obs_metrics.record_serve("prefix_hits")
+                _obs_metrics.record_serve("prefix_tokens_saved",
+                                          delta=req.covered)
+        self._chunking[slot] = req
+
+    def _on_resume(self, req: _Request) -> None:
+        self.resumed += 1
+        if _obs.enabled():
+            _obs_metrics.record_serve("resumed", event=True,
+                                      request=req.request_id,
+                                      n_tokens=len(req.tokens))
+
+    def _preempt_one(self) -> bool:
+        """Spill the most recently admitted batch-lane sequence: free its
+        pages (shared ones just decref — the prefix cache keeps them warm)
+        and requeue it at the FRONT of the batch lane for resume."""
+        victim = None
+        for i, r in enumerate(self._slots):
+            if (r is not None and r.lane == "batch"
+                    and (victim is None
+                         or r.admit_seq > self._slots[victim].admit_seq)):
+                victim = i
+        if victim is None:
+            return False
+        req = self._slots[victim]
+        self.cache.allocator.free(req.pages)
+        req.pages = []
+        self._clear_slot(victim)
+        with self._lock:
+            self._pending_batch.appendleft(req)
+        self.preempted += 1
+        if _obs.enabled():
+            _obs_metrics.record_serve("preempted", event=True,
+                                      request=req.request_id,
+                                      n_tokens=len(req.tokens))
+        return True
+
+    def _maybe_preempt_for_slo(self) -> None:
+        """Burn-rate-driven preemption: when the SLO monitor reports a
+        breached or burning target while interactive requests queue, shed
+        one batch sequence per iteration to shorten the interactive path."""
+        if (not self.preemption or self.slo_monitor is None
+                or not self._pending):
+            return
+        status = self.slo_monitor.status()
+        burning = bool(status.get("breached")) or any(
+            t.get("burn_rate") is not None and t["burn_rate"] >= 1.0
+            for t in status.get("targets", {}).values())
+        if burning:
+            self._preempt_one()
 
     def _fail(self, req: _Request, exc: Exception) -> None:
         """Contain one request's failure: return its pages, fail its Future
@@ -395,12 +710,14 @@ class ServingEngine:
 
     def _prefill(self, req: _Request, slot: int) -> None:
         obs_on = _obs.enabled()
-        L = len(req.prompt)
+        resumed = bool(req.tokens)
+        L = len(req.prompt_eff) if req.prompt_eff is not None else len(req.prompt)
+        prompt_eff = req.prompt_eff if req.prompt_eff is not None else req.prompt
         bucket = self.ladder.touch(L)
         req.bucket = bucket
         n_prompt_pages = bucket // self.page_size
         idx = np.zeros((1, bucket), np.int32)
-        idx[0, :L] = req.prompt
+        idx[0, :L] = prompt_eff
         page_ids = jnp.asarray(req.pages[:n_prompt_pages], jnp.int32)
         t0 = time.perf_counter()
         try:
@@ -412,33 +729,165 @@ class ServingEngine:
                     self.cache.k_pages, self.cache.v_pages,
                     jnp.asarray(L - 1, jnp.int32))
                 self.cache.rebind(kps, vps)
-                tok0 = self._sampler(logits,
-                                     jnp.asarray([req.seed], jnp.uint32),
-                                     jnp.asarray([L], jnp.int32),
-                                     jnp.asarray([req.temperature], jnp.float32))
-                tok0 = int(np.asarray(tok0)[0])
+                if self.draft_cache is not None:
+                    # the draft pool must hold the prompt too — same pages,
+                    # same positions, draft weights (logits discarded)
+                    _, dkps, dvps = self.draft_runner.prefill_cfn(
+                        self.draft_params, jnp.asarray(idx), page_ids,
+                        self.draft_cache.k_pages, self.draft_cache.v_pages,
+                        jnp.asarray(L - 1, jnp.int32))
+                    self.draft_cache.rebind(dkps, dvps)
+                if not resumed:
+                    tok0 = self._sampler(logits,
+                                         jnp.asarray([req.seed], jnp.uint32),
+                                         jnp.asarray([L], jnp.int32),
+                                         jnp.asarray([req.temperature], jnp.float32))
+                    tok0 = int(np.asarray(tok0)[0])
+        except Exception as e:
+            self._fail(req, e)
+            return
+        if self.prefix is not None:
+            self.prefix.insert(prompt_eff, req.pages)
+        t_done = time.perf_counter()
+        if obs_on:
+            util = round(self.cache.utilization(), 4)
+            _obs_metrics.record_serve("prefills", event=True,
+                                      request=req.request_id, bucket=bucket,
+                                      prompt_len=L, ms=round((t_done - t0) * 1e3, 3),
+                                      pool_utilization=util)
+            _obs_metrics.record_serve("prefill_tokens", delta=L)
+            _obs_tel.observe("serve.prefill_ms", (t_done - t0) * 1e3)
+            _obs_tel.set_gauge("serve.pool_utilization", util)
+            _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
+        if resumed:
+            # the spilled stream already owns its next token; no sampling
+            # (and t_first keeps the FIRST life's stamp — TTFT is end-to-end)
+            self._on_resume(req)
+            self._activate(req, slot, pos=L, tok=req.tokens[-1])
+            return
+        req.t_first = req.t_last = t_done
+        req.tokens.append(tok0)
+        if self._finished(req, tok0):
+            self._retire(req)
+            return
+        self._activate(req, slot, pos=L, tok=tok0)
+
+    def _advance_prefills(self) -> None:
+        """Run queued prefill chunks under the per-iteration token budget.
+        At least one chunk always runs when any is pending (progress even
+        when a single chunk exceeds the budget); chunks from multiple
+        requests share the budget in slot order."""
+        if not self._chunking:
+            return
+        spent = 0
+        for slot in sorted(self._chunking):
+            req = self._chunking[slot]
+            while spent < self.prefill_budget:
+                try:
+                    n_toks, logits = self._run_chunk(req)
+                except Exception as e:
+                    del self._chunking[slot]
+                    self._fail(req, e)
+                    break
+                spent += n_toks
+                if req.chunk_pos >= len(req.prompt_eff):
+                    del self._chunking[slot]
+                    self._finish_chunked(req, slot, logits)
+                    break
+            if spent >= self.prefill_budget:
+                return
+
+    def _run_chunk(self, req: _Request):
+        """One page-aligned chunk of req's effective prompt: write K/V pages,
+        attend everything written so far (shared prefix pages included).
+        Returns (tokens_spent, logits) — logits only meaningful when this
+        was the final chunk."""
+        ps = self.page_size
+        L_eff = len(req.prompt_eff)
+        start = req.chunk_pos
+        remaining = L_eff - start
+        if remaining > self.chunk_tokens:
+            cb = self.chunk_tokens
+            last_rel = cb - 1  # logits discarded; any in-range index works
+        else:
+            cb = self.chunk_ladder.touch(remaining)
+            if start + cb > self.max_seq:
+                # the rounded rung would cross max_seq (and the rope table):
+                # fall back to the exact page-aligned remainder
+                cb = PagedKVCache.pages_for(remaining, ps) * ps
+            last_rel = remaining - 1
+        idx = np.zeros((1, cb), np.int32)
+        n_real = min(cb, remaining)
+        idx[0, :n_real] = req.prompt_eff[start:start + n_real]
+        row = jnp.asarray(
+            self.cache.page_table_row(req.pages, self.n_pages_max)[None, :])
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter()
+        with (_obs_runtime.step_span("serve_prefill", request=req.request_id,
+                                     bucket=cb, prompt_len=L_eff, chunk=True,
+                                     start=start)
+              if obs_on else _NULL):
+            logits, kps, vps = self.runner.chunk_cfn(
+                self.params, jnp.asarray(idx), row, self.cache.k_pages,
+                self.cache.v_pages, jnp.asarray(start, jnp.int32),
+                jnp.asarray(last_rel, jnp.int32))
+            self.cache.rebind(kps, vps)
+            if self.draft_cache is not None:
+                _, dkps, dvps = self.draft_runner.chunk_cfn(
+                    self.draft_params, jnp.asarray(idx), row,
+                    self.draft_cache.k_pages, self.draft_cache.v_pages,
+                    jnp.asarray(start, jnp.int32),
+                    jnp.asarray(last_rel, jnp.int32))
+                self.draft_cache.rebind(dkps, dvps)
+        req.chunk_pos = min(start + cb, L_eff)
+        if obs_on:
+            _obs_metrics.record_serve("prefill_tokens", delta=n_real)
+            _obs_tel.observe("serve.prefill_ms",
+                             (time.perf_counter() - t0) * 1e3)
+        return cb, logits
+
+    def _finish_chunked(self, req: _Request, slot: int, logits) -> None:
+        """Final chunk done: register the prompt's full pages in the prefix
+        cache, sample the first token (fresh requests), activate the slot."""
+        obs_on = _obs.enabled()
+        L_eff = len(req.prompt_eff)
+        req.bucket = self.ladder.bucket_for(L_eff)
+        if self.prefix is not None:
+            self.prefix.insert(req.prompt_eff, req.pages)
+        if obs_on:
+            util = round(self.cache.utilization(), 4)
+            _obs_metrics.record_serve("prefills", event=True,
+                                      request=req.request_id,
+                                      bucket=req.bucket, prompt_len=L_eff,
+                                      chunked=True, pool_utilization=util)
+            _obs_tel.set_gauge("serve.pool_utilization", util)
+            _obs_tel.set_gauge("serve.pages_in_use",
+                               self.cache.allocator.n_used)
+        if req.tokens:
+            self._on_resume(req)
+            self._activate(req, slot, pos=L_eff, tok=req.tokens[-1])
+            return
+        try:
+            tok0 = self._sampler(logits, jnp.asarray([req.seed], jnp.uint32),
+                                 jnp.asarray([L_eff], jnp.int32),
+                                 jnp.asarray([req.temperature], jnp.float32))
+            tok0 = int(np.asarray(tok0)[0])
         except Exception as e:
             self._fail(req, e)
             return
         req.t_first = req.t_last = time.perf_counter()
         req.tokens.append(tok0)
-        if obs_on:
-            util = round(self.cache.utilization(), 4)
-            _obs_metrics.record_serve("prefills", event=True,
-                                      request=req.request_id, bucket=bucket,
-                                      prompt_len=L, ms=round((req.t_first - t0) * 1e3, 3),
-                                      pool_utilization=util)
-            _obs_metrics.record_serve("prefill_tokens", delta=L)
-            _obs_tel.observe("serve.prefill_ms", (req.t_first - t0) * 1e3)
-            _obs_tel.set_gauge("serve.pool_utilization", util)
-            _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
         if self._finished(req, tok0):
             self._retire(req)
             return
+        self._activate(req, slot, pos=L_eff, tok=tok0)
+
+    def _activate(self, req: _Request, slot: int, *, pos: int, tok: int) -> None:
         self._slots[slot] = req
-        self._page_tables[slot] = self.cache.page_table_row(req.pages, self.n_pages_max)
-        self._pos[slot] = L
-        self._toks[slot] = tok0
+        self._page_tables[slot] = self.cache.page_table_row(req.pages,
+                                                            self.n_pages_max)
+        self._pos[slot] = pos
+        self._toks[slot] = tok
         self._seeds[slot] = req.seed
         self._temps[slot] = req.temperature
         self._pt_dirty = True
@@ -452,19 +901,42 @@ class ServingEngine:
         self._temps[i] = 0.0
         self._pt_dirty = True
 
+    def _upload_packed_state(self) -> None:
+        # page tables / seeds / temps only change at slot (un)assignment;
+        # re-upload them then, not per token (pos/toks change every step)
+        if self._pt_dirty:
+            self._pt_dev = jnp.asarray(self._page_tables)
+            self._seeds_dev = jnp.asarray(self._seeds)
+            self._temps_dev = jnp.asarray(self._temps)
+            self._pt_dirty = False
+
+    def _commit(self, i: int, req: _Request, tok: int, t_now: float) -> bool:
+        """Commit one generated token to slot i; returns False when the
+        request finished (retired, slot cleared)."""
+        if req.t_first == 0.0:
+            # prefix-hit admissions skip prefill: TTFT stamps at the first
+            # committed token instead
+            req.t_first = t_now
+        req.tokens.append(tok)
+        req.t_last = t_now
+        self._pos[i] += 1
+        self._toks[i] = tok
+        if self._finished(req, tok):
+            self._retire(req)
+            self._clear_slot(i)
+            return False
+        return True
+
     def _decode(self) -> None:
+        if self.draft_cache is not None and self.spec_k > 0:
+            self._spec_decode()
+            return
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
             return
         obs_on = _obs.enabled()
         t0 = time.perf_counter()
-        if self._pt_dirty:
-            # page tables / seeds / temps only change at slot (un)assignment;
-            # re-upload them then, not per token (pos/toks change every step)
-            self._pt_dev = jnp.asarray(self._page_tables)
-            self._seeds_dev = jnp.asarray(self._seeds)
-            self._temps_dev = jnp.asarray(self._temps)
-            self._pt_dirty = False
+        self._upload_packed_state()
         try:
             with (_obs_runtime.step_span("serve_decode", active=len(active))
                   if obs_on else _NULL):
@@ -497,15 +969,89 @@ class ServingEngine:
             # the flight recorder — TT_OBS_SAMPLE only thins the spans)
             _obs_tel.observe("serve.decode_ms", (t_now - t0) * 1e3)
         for i in active:
-            req = self._slots[i]
-            tok = int(nxt[i])
-            req.tokens.append(tok)
-            req.t_last = t_now
-            self._pos[i] += 1
-            self._toks[i] = tok
-            if self._finished(req, tok):
-                self._retire(req)
+            self._commit(i, self._slots[i], int(nxt[i]), t_now)
+
+    def _spec_decode(self) -> None:
+        """Speculative decode iteration: k draft decode steps propose, one
+        packed target verify step scores all k+1 positions, the accepted
+        prefix commits (capped at k — NO bonus token, which is what keeps
+        the draft pool valid through the new position without a catch-up
+        pass). The draft proposes with the SAME position-keyed sampler, so
+        a perfect draft accepts everything and every committed token is
+        bit-identical to plain decode either way."""
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return
+        obs_on = _obs.enabled()
+        k = self.spec_k
+        K1 = k + 1
+        t0 = time.perf_counter()
+        self._upload_packed_state()
+        try:
+            with (_obs_runtime.step_span("serve_decode", active=len(active),
+                                         spec_k=k)
+                  if obs_on else _NULL):
+                base_pos = self._pos.copy()
+                cand = [self._toks.copy()]
+                cur = jnp.asarray(self._toks[:, None])
+                for j in range(1, k + 1):
+                    dlog, dkps, dvps = self.draft_runner.decode_cfn(
+                        self.draft_params, cur, self.draft_cache.k_pages,
+                        self.draft_cache.v_pages, self._pt_dev,
+                        jnp.asarray(base_pos + (j - 1)))
+                    self.draft_cache.rebind(dkps, dvps)
+                    dj = np.asarray(self._sampler(
+                        dlog, self._seeds_dev, jnp.asarray(base_pos + j),
+                        self._temps_dev))
+                    cand.append(dj)
+                    cur = jnp.asarray(dj[:, None])
+                toks_mat = np.stack(cand, axis=1)  # (max_batch, k+1)
+                vlog, kps, vps = self.runner.verify_cfn(
+                    self.params, jnp.asarray(toks_mat), self.cache.k_pages,
+                    self.cache.v_pages, self._pt_dev, jnp.asarray(base_pos))
+                self.cache.rebind(kps, vps)
+                B = toks_mat.shape[0]
+                pos_flat = (base_pos[:, None] + 1
+                            + np.arange(K1, dtype=np.int32)[None, :]).reshape(-1)
+                samples = np.asarray(self._sampler(
+                    jnp.reshape(vlog, (B * K1, -1)),
+                    jnp.asarray(np.repeat(self._seeds, K1)),
+                    jnp.asarray(pos_flat),
+                    jnp.asarray(np.repeat(self._temps, K1)))).reshape(B, K1)
+        except Exception as e:
+            for i in active:
+                self._fail(self._slots[i], e)
                 self._clear_slot(i)
+            return
+        t_now = time.perf_counter()
+        self.decode_steps += 1
+        committed_total = 0
+        accepted_total = 0
+        for i in active:
+            req = self._slots[i]
+            m = 0
+            while m < k and toks_mat[i, m + 1] == samples[i, m]:
+                m += 1
+            # commit the accepted samples; min(m+1, k) keeps the draft pool
+            # valid (a bonus k+1th token would advance the target one
+            # position past anything the draft ever wrote)
+            n = min(m + 1, k)
+            self.spec_proposed += k
+            self.spec_accepted += m
+            accepted_total += m
+            for j in range(n):
+                committed_total += 1
+                if not self._commit(i, req, int(samples[i, j]), t_now):
+                    break
+        if obs_on:
+            _obs_metrics.record_serve("decode_steps")
+            _obs_metrics.record_serve("tokens", delta=committed_total)
+            _obs_metrics.record_serve("spec_proposed", delta=k * len(active))
+            _obs_metrics.record_serve("spec_accepted", delta=accepted_total)
+            _obs_flight.record_step((t_now - t0) * 1e3, fn="serve_decode",
+                                    active=len(active), spec_k=k,
+                                    committed=committed_total)
+            _obs_tel.observe("serve.decode_ms", (t_now - t0) * 1e3)
 
     def _finished(self, req: _Request, tok: int) -> bool:
         if req.future.cancelled():
@@ -519,7 +1065,9 @@ class ServingEngine:
         self.cache.allocator.free(req.pages)
         req.pages = []
         n_new = len(req.tokens)
-        ttft = req.t_first - req.t_submit
+        # t_first == 0.0 only for a prefix-hit request cancelled before its
+        # first committed token — report a zero TTFT rather than a negative
+        ttft = (req.t_first - req.t_submit) if req.t_first else 0.0
         tbot = ((req.t_last - req.t_first) / (n_new - 1)) if n_new > 1 else 0.0
         if req.future.cancelled():
             # a client-side cancel is not a completion: tag it so latency
@@ -545,10 +1093,13 @@ class ServingEngine:
             if obs_on:
                 # streaming percentiles: the online mirror of the offline
                 # serving section's TTFT/TBOT populations (cancelled
-                # requests excluded from both)
+                # requests excluded from both); per-lane series alongside
+                # the aggregate so SLO triage can split interactive vs batch
                 _obs_tel.observe("serve.ttft_ms", ttft_ms)
+                _obs_tel.observe(f"serve.ttft_ms.{req.lane}", ttft_ms)
                 if tbot_ms is not None:
                     _obs_tel.observe("serve.tbot_ms", tbot_ms)
+                    _obs_tel.observe(f"serve.tbot_ms.{req.lane}", tbot_ms)
             if self.slo_monitor is not None:
                 self.slo_monitor.observe_request(
                     ttft_ms=ttft_ms, tbot_ms=tbot_ms, met=bool(slo_met),
@@ -565,7 +1116,7 @@ class ServingEngine:
                 "cancelled" if reason == "cancelled" else "retired",
                 event=True, request=req.request_id, n_new=n_new,
                 ttft_ms=round(ttft * 1e3, 3), tbot_ms=round(tbot * 1e3, 3),
-                finish=reason, pool_utilization=util)
+                finish=reason, lane=req.lane, pool_utilization=util)
         result = RequestResult(
             request_id=req.request_id,
             tokens=np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)]),

@@ -265,6 +265,22 @@ def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     if tbots:
         lines.append(f"  tbot_ms                  p50={pct(tbots, 0.5):.2f}  "
                      f"p99={pct(tbots, 0.99):.2f}  max={tbots[-1]:.2f}")
+    # per-lane breakdown (serve_retired carries lane= since the SLO-aware
+    # scheduler): only shown when traffic actually spans more than one lane,
+    # so single-lane runs keep the compact aggregate-only section
+    lanes = sorted({a.get("lane") for a in retires if a.get("lane")})
+    if len(lanes) > 1:
+        for lane in lanes:
+            sub = [a for a in retires if a.get("lane") == lane]
+            lt = sorted(a["ttft_ms"] for a in sub if "ttft_ms" in a)
+            lb = sorted(a["tbot_ms"] for a in sub
+                        if "tbot_ms" in a and a.get("n_new", 0) > 1)
+            parts = [f"n={len(sub)}"]
+            if lt:
+                parts.append(f"ttft p50={pct(lt, 0.5):.2f} p99={pct(lt, 0.99):.2f}")
+            if lb:
+                parts.append(f"tbot p50={pct(lb, 0.5):.2f} p99={pct(lb, 0.99):.2f}")
+            lines.append(f"  lane {lane:<19} {'  '.join(parts)}")
     utils = [a["pool_utilization"] for a in retires + [
         r.get("attrs", {}) for r in recs
         if r.get("kind") == "event" and r.get("name") == "serve_prefills"]

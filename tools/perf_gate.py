@@ -43,6 +43,12 @@ from typing import Optional
 HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
                  "mfu", "mfu_measured", "tflops_per_sec", "vs_baseline",
                  "baseline_tokens_per_sec",
+                 # fleet serving (BENCH_SERVE_FLEET.json): the prefix cache
+                 # and the speculative pipeline must keep ENGAGING, not just
+                 # keep the headline throughput — a hit rate or accept rate
+                 # decaying toward zero means the stage silently disabled
+                 # itself while batching absorbed the loss
+                 "prefix_hit_rate", "spec_accept_rate",
                  # warm starts must keep being served FROM THE STORE: a hit
                  # count falling to zero means the compile service silently
                  # stopped engaging even if wall time still looks ok
