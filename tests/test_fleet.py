@@ -1,0 +1,525 @@
+"""Fleet observability: exact histogram merges, straggler detection,
+request tracing, incident correlation (ISSUE 17).
+
+The contracts under test:
+
+* **Exact merge identity** — ``StreamingHistogram.from_states([A, B])``
+  reports the SAME quantiles as one histogram fed both sample streams
+  (shared log-bucket index space ⇒ bucket-wise merge is exact), so fleet
+  p99s are never averages-of-percentiles.
+* **Zero work when disabled** — submitting/running requests with the bus
+  off mints no trace ids, bumps no counters, stalls nothing.
+* **End-to-end tracing** — a request that survives preemption renders a
+  complete submitted → preempted → resumed → retired timeline, and the
+  shared per-step events expand per participant.
+* **Straggler detection** — a host whose median rides above factor× the
+  fleet median is flagged ONCE (transition-deduped) with the dominant
+  flight-recorder cause; recovery emits ``straggler.recovered``.
+* **events.reset() scope** — the reset satellite: one call clears the
+  ring, counters, telemetry, the flight recorder, and SLO windows.
+
+The 2-process end-to-end test (markers slow+dist) drives the real KV
+publish/collect/merge path under ``LocalCluster(2)`` with an injected
+``slow`` fault on host 1.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from thunder_tpu import observability as obs
+from thunder_tpu.observability import (events, fleet, flight_recorder, slo,
+                                       telemetry, tracing)
+from thunder_tpu.observability.telemetry import StreamingHistogram
+
+pytestmark = pytest.mark.telemetry
+
+
+def _load_obs_summary():
+    """tools/obs_summary.py is deliberately stdlib-only and not a package —
+    load it by path, the way operators run it."""
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "obs_summary.py")
+    spec = importlib.util.spec_from_file_location("obs_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+obs_summary = _load_obs_summary()
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    events.disable()
+    events.reset()
+    yield
+    events.disable()
+    events.reset()
+
+
+# ---------------------------------------------------------------------------
+# exact bucket-wise histogram merge
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramMerge:
+    def test_merge_identity_exact(self):
+        """merged(A, B) must report IDENTICAL quantiles to a single
+        histogram fed both streams — not approximately, exactly: both
+        sides collapse to the same bucket-count map."""
+        rng = np.random.RandomState(7)
+        a_samples = np.exp(rng.randn(4000) * 1.5 + 1.0)
+        b_samples = np.exp(rng.randn(1000) * 0.5 + 4.0)  # different regime
+        ha, hb, hboth = (StreamingHistogram() for _ in range(3))
+        for v in a_samples:
+            ha.observe(float(v))
+            hboth.observe(float(v))
+        for v in b_samples:
+            hb.observe(float(v))
+            hboth.observe(float(v))
+        merged = StreamingHistogram.from_states([ha.state(), hb.state()])
+        assert merged.count == hboth.count == 5000
+        for q in (0.01, 0.25, 0.5, 0.9, 0.99, 0.999):
+            assert merged.quantile(q) == hboth.quantile(q), q
+        assert merged.min == hboth.min and merged.max == hboth.max
+        # float addition order differs between the two constructions
+        assert merged.sum == pytest.approx(hboth.sum, rel=1e-9)
+
+    def test_merge_handles_zero_and_negative(self):
+        ha, hb, hboth = (StreamingHistogram() for _ in range(3))
+        for h, vals in ((ha, [0.0, -3.0, 5.0]), (hb, [0.0, 7.0])):
+            for v in vals:
+                h.observe(v)
+                hboth.observe(v)
+        merged = StreamingHistogram.from_states([ha.state(), hb.state()])
+        assert merged.count == hboth.count == 5
+        for q in (0.1, 0.5, 0.9):
+            assert merged.quantile(q) == hboth.quantile(q)
+
+    def test_alpha_mismatch_refused(self):
+        h = StreamingHistogram(alpha=0.01)
+        other = StreamingHistogram(alpha=0.02)
+        other.observe(1.0)
+        with pytest.raises(ValueError, match="alpha"):
+            h.merge_state(other.state())
+
+    def test_empty_states(self):
+        assert StreamingHistogram.from_states([]).count == 0
+        h = StreamingHistogram()
+        h.observe(2.0)
+        merged = StreamingHistogram.from_states(
+            [h.state(), StreamingHistogram().state()])
+        assert merged.count == 1 and merged.quantile(0.5) == h.quantile(0.5)
+
+    def test_state_json_round_trip(self):
+        """Snapshots travel through the coordination KV as JSON — the
+        state must survive serialization (string bucket keys)."""
+        h = StreamingHistogram()
+        for v in (0.5, 3.0, 3.0, 40.0):
+            h.observe(v)
+        wire = json.loads(json.dumps(h.state()))
+        back = StreamingHistogram.from_states([wire])
+        for q in (0.1, 0.5, 0.99):
+            assert back.quantile(q) == h.quantile(q)
+
+
+# ---------------------------------------------------------------------------
+# events.reset() scope (satellite: flight recorder + SLO windows)
+# ---------------------------------------------------------------------------
+
+
+class TestResetScope:
+    def test_reset_clears_flight_recorder_and_slo_windows(self):
+        events.enable()
+        for i in range(16):
+            flight_recorder.record_step(3.0 + 0.01 * i)
+        mon = slo.SLOMonitor(slo.SLOPolicy(p99_ttft_ms=1.0, min_samples=2,
+                                           objective=0.5))
+        for _ in range(8):
+            mon.observe_request(ttft_ms=50.0, tbot_ms=None, met=False)
+        telemetry.observe("x.ms", 5.0)
+        assert flight_recorder.stats() is not None
+        assert mon.breaches >= 1
+        events.reset()
+        assert flight_recorder.stats() is None
+        assert telemetry.histogram("x.ms") is None
+        st = mon.status()
+        assert mon.breaches == 0
+        assert not any(t.get("breached") for t in st.get("targets", {}).values())
+        # a fresh breach after reset re-fires (the monitor is re-armed,
+        # not wedged in its old breached latch)
+        for _ in range(8):
+            mon.observe_request(ttft_ms=50.0, tbot_ms=None, met=False)
+        assert mon.breaches >= 1
+
+
+# ---------------------------------------------------------------------------
+# tracing: zero-work disabled, timeline, chrome export
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_disabled_path_does_no_work(self):
+        """Counter-asserted zero-work contract: with the bus off, the trace
+        plumbing mints nothing and counts nothing."""
+        assert not events.enabled()
+        tracing.trace_event(None, "retired")
+        tracing.trace_step([None, None], "decode", dur_ms=1.0)
+        assert events.counters() == {}
+        assert events.records() == []
+
+    def test_disabled_overhead_probe_is_sub_microsecond_scale(self):
+        # generous ceiling: the probe exists to gate regressions via the
+        # bench baseline, this just pins the order of magnitude
+        assert tracing.disabled_overhead_us(n=2000, repeats=2) < 50.0
+
+    def test_timeline_and_shared_step_expansion(self):
+        events.enable()
+        t1, t2 = tracing.new_trace_id(), tracing.new_trace_id()
+        tracing.trace_event(t1, "submitted", request=7, lane="interactive")
+        tracing.trace_event(t2, "submitted", request=8, lane="batch")
+        tracing.trace_step([t1, t2], "decode", dur_ms=2.0, step=1)
+        tracing.trace_step([t2], "decode", dur_ms=2.0, step=2)
+        tracing.trace_event(t1, "retired", request=7, finish="length")
+        recs = events.records()
+        assert tracing.resolve_trace_id(recs, 7) == t1
+        assert tracing.resolve_trace_id(recs, "7") == t1  # CLI string form
+        tl1 = tracing.timeline(recs, request_id=7)
+        assert [e["phase"] for e in tl1] == ["submitted", "decode", "retired"]
+        tl2 = tracing.timeline(recs, trace_id=t2)
+        assert [e["phase"] for e in tl2] == ["submitted", "decode", "decode"]
+        c = events.counters()
+        assert c["trace.requests"] == 2
+        assert c["trace.spans"] == 2 + 3 + 1  # per participant, not per event
+
+    def test_chrome_trace_shapes(self, tmp_path):
+        events.enable()
+        t = tracing.new_trace_id()
+        tracing.trace_event(t, "submitted", request=1)
+        tracing.trace_event(t, "prefill", request=1, dur_ms=4.0)
+        tracing.trace_event(t, "retired", request=1)
+        evs = tracing.chrome_trace(events.records(), request_id=1)
+        assert [e["ph"] for e in evs] == ["i", "X", "i"]
+        x = evs[1]
+        assert x["dur"] == 4000.0  # µs
+        # complete event starts dur before its (end-stamped) emit time
+        retired_ts = evs[2]["ts"]
+        assert x["ts"] + x["dur"] <= retired_ts + 1e-6
+        out = tracing.write_chrome_trace(str(tmp_path / "t.json"),
+                                         events.records(), trace_id=t)
+        data = json.load(open(out))
+        assert len(data["traceEvents"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# fleet merge + straggler detection (single process, hand-built snapshots)
+# ---------------------------------------------------------------------------
+
+
+def _snap(host, median_ms, count=32, causes=None, counters=None, hists=None):
+    return {"host": host, "ts_ms": 1000.0, "counters": counters or {},
+            "gauges": {}, "hists": hists or {},
+            "steps": {"count": count, "median_ms": median_ms,
+                      "p99_ms": median_ms * 1.2, "max_ms": median_ms * 1.5,
+                      "spikes": 0, "causes": causes or {}}}
+
+
+class TestFleetMerge:
+    def test_single_process_fleet_snapshot(self):
+        events.enable()
+        events.inc("serve.requests", 3)
+        telemetry.observe("train.step_ms", 4.0)
+        snap = fleet.fleet_snapshot()
+        assert snap["n_hosts"] == 1
+        assert snap["counters"]["serve.requests"] == 3
+        assert snap["histograms"]["train.step_ms"]["count"] == 1
+        assert snap["stragglers"] == []
+        assert list(snap["hosts"]) == [0] or len(snap["hosts"]) == 1
+
+    def test_merge_sums_counters_and_merges_hists(self):
+        h0, h1 = StreamingHistogram(), StreamingHistogram()
+        for v in (1.0, 2.0):
+            h0.observe(v)
+        for v in (30.0, 40.0):
+            h1.observe(v)
+        merged = fleet.merge({
+            0: _snap(0, 3.0, counters={"serve.requests": 2},
+                     hists={"serve.ttft_ms": h0.state()}),
+            1: _snap(1, 3.1, counters={"serve.requests": 5},
+                     hists={"serve.ttft_ms": h1.state()}),
+        })
+        assert merged["n_hosts"] == 2
+        assert merged["counters"]["serve.requests"] == 7
+        hist = merged["histograms"]["serve.ttft_ms"]
+        assert hist["count"] == 4
+        both = StreamingHistogram()
+        for v in (1.0, 2.0, 30.0, 40.0):
+            both.observe(v)
+        assert merged["_merged_hists"]["serve.ttft_ms"].quantile(0.99) \
+            == both.quantile(0.99)
+
+    def test_straggler_flagged_once_with_cause_then_recovers(self):
+        events.enable()
+        det = fleet.StragglerDetector(factor=2.0, min_steps=8)
+        slow = {0: _snap(0, 3.0), 1: _snap(1, 30.0,
+                                           causes={"data-stall": 5,
+                                                   "recompile": 1})}
+        out1 = det.evaluate(slow)
+        assert len(out1) == 1
+        rec = out1[0]
+        assert rec["host"] == 1 and rec["cause"] == "data-stall"
+        assert rec["ratio"] == pytest.approx(10.0)
+        # second poll: still straggling, but NOT re-announced
+        det.evaluate(slow)
+        strag_events = [r for r in events.records()
+                        if r.get("name") == "straggler"]
+        assert len(strag_events) == 1
+        assert events.counters()["fleet.straggler"] == 1
+        # recovery emits the transition event
+        det.evaluate({0: _snap(0, 3.0), 1: _snap(1, 3.2)})
+        assert any(r.get("name") == "straggler.recovered"
+                   for r in events.records())
+
+    def test_straggler_needs_min_steps_and_two_hosts(self):
+        det = fleet.StragglerDetector(factor=2.0, min_steps=8)
+        assert det.evaluate({0: _snap(0, 3.0, count=2),
+                             1: _snap(1, 99.0, count=2)}) == []
+        assert det.evaluate({1: _snap(1, 99.0)}) == []
+
+    def test_render_prometheus_fleet_labels(self):
+        events.enable()
+        events.inc("serve.requests", 4)
+        telemetry.observe("serve.ttft_ms", 2.0)
+        body = fleet.render_prometheus_fleet()
+        assert 'tt_serve_requests{host="0"} 4' in body
+        assert 'tt_serve_requests{host="fleet"} 4' in body
+        assert 'tt_serve_ttft_ms_bucket{host="fleet",le="+Inf"} 1' in body
+
+    def test_exporter_fleet_mode_serves_merged_view(self):
+        events.enable()
+        events.inc("serve.requests", 2)
+        exp = telemetry.MetricsExporter("unused.prom", fleet=True)
+        body = exp._render()
+        assert 'tt_serve_requests{host="fleet"} 2' in body
+
+
+# ---------------------------------------------------------------------------
+# incident correlation
+# ---------------------------------------------------------------------------
+
+
+class TestIncidents:
+    def test_breach_joins_contemporaneous_evidence_ranked(self):
+        events.enable()
+        events.event("recompile", reason="shape-change")
+        events.event("straggler", host=1, cause="data-stall")
+        events.event("step_spike", step=9, cause="checkpoint-save")
+        events.event("serve_prefills", request=3, pool_utilization=0.95)
+        events.event("slo.breach", reason="p99-ttft", source="serve",
+                     value=812.0, target=750.0)
+        incs = obs.incidents()
+        assert len(incs) == 1
+        inc = incs[0]
+        assert inc["reason"] == "p99-ttft" and inc["value"] == 812.0
+        causes = dict(inc["likely_causes"])
+        assert causes["recompile"] == 4.0
+        assert causes["straggler-host-1-data-stall"] == 3.0
+        assert causes["spike-checkpoint-save"] == 2.0
+        assert causes["pool-pressure"] == 1.0
+        ranked = [c for c, _ in inc["likely_causes"]]
+        assert ranked[0] == "recompile"
+        assert inc["evidence"] == {"spikes": 1, "recompiles": 1,
+                                   "stragglers": 1, "pool_pressure": 1}
+
+    def test_evidence_window_excludes_distant_events(self):
+        events.enable()
+        recs = [
+            {"kind": "event", "name": "recompile", "ts_ms": 100.0,
+             "attrs": {"reason": "cache-miss"}},
+            {"kind": "event", "name": "slo.breach", "ts_ms": 50_000.0,
+             "attrs": {"reason": "goodput", "source": "serve",
+                       "value": 0.5, "target": 0.9}},
+        ]
+        incs = obs.incidents(records=recs)
+        assert len(incs) == 1
+        assert incs[0]["likely_causes"] == []
+        assert incs[0]["evidence"]["recompiles"] == 0
+
+    def test_no_breach_no_incident(self):
+        events.enable()
+        events.event("recompile", reason="cache-miss")
+        assert obs.incidents() == []
+
+
+# ---------------------------------------------------------------------------
+# real engine: a preempted request's end-to-end trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestServeTraceEndToEnd:
+    def test_preempted_request_renders_full_timeline(self, tmp_path):
+        """The acceptance trace: a request that survives preemption renders
+        submitted -> preempted -> resumed -> retired, through the real
+        engine and the real CLI reader."""
+        import jax.numpy as jnp
+
+        from thunder_tpu.models.litgpt import Config, GPT
+        from thunder_tpu.serving import ServingEngine
+
+        events.enable()
+        cfg = Config.from_name("tiny-llama2", block_size=64)
+        engine = ServingEngine(GPT(cfg, dtype=jnp.float32), max_batch=4,
+                               page_size=8, max_seq=64, dtype=jnp.float32,
+                               n_pages=9)                  # 8 usable
+        rng = np.random.RandomState(0)
+        victim_p = rng.randint(0, cfg.vocab_size, (9,)).astype(np.int32)
+        engine.submit(victim_p, max_new_tokens=20, lane="batch")  # rid 0
+        engine._step_once()
+        engine._step_once()
+        # an interactive request needing the whole pool forces the spill
+        inter_p = rng.randint(0, cfg.vocab_size, (33,)).astype(np.int32)
+        engine.submit(inter_p, max_new_tokens=5)
+        engine.drain()
+        assert engine.preempted == 1 and engine.resumed == 1
+
+        recs = events.records()
+        phases = [e["phase"] for e in tracing.timeline(recs, request_id=0)]
+        assert phases[0] == "submitted" and phases[-1] == "retired"
+        for p in ("admitted", "prefill", "decode", "preempted", "resumed"):
+            assert p in phases, phases
+        assert phases.index("preempted") < phases.index("resumed")
+        # decoding resumes after the spill, not just before it
+        assert "decode" in phases[phases.index("resumed"):]
+        assert events.counters()["trace.requests"] == 2
+
+        # the CLI reader renders the same records (stdlib reimplementation)
+        text = obs_summary.render_trace(recs, "0")
+        for needle in ("submitted", "preempted", "resumed", "retired",
+                       "end to end"):
+            assert needle in text
+        chrome = obs_summary.chrome_trace_json(recs, "0")
+        assert {e["ph"] for e in chrome["traceEvents"]} <= {"X", "i"}
+        assert any(e["ph"] == "X" for e in chrome["traceEvents"])
+        out = tmp_path / "t.json"
+        out.write_text(json.dumps(chrome))
+        assert json.loads(out.read_text())["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# 2-process end-to-end: KV snapshot exchange, exact merge, injected straggler
+# ---------------------------------------------------------------------------
+
+FLEET_WORKER = """
+import time
+
+import jax
+
+import thunder_tpu  # noqa: F401 - joins the cluster; TT_OBS_FILE arms the bus
+from thunder_tpu.observability import (events, fleet, flight_recorder,
+                                       telemetry, tracing)
+from thunder_tpu.parallel import multiprocess as mp
+from thunder_tpu.robustness import faults  # TT_FAULT parsed at import
+
+PID = jax.process_index()
+
+for i in range(24):
+    t0 = time.perf_counter()
+    faults.maybe_sleep(i)   # host 1: +30ms injected stall (emits data_stall)
+    time.sleep(0.003)       # the "real" step
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    flight_recorder.record_step(wall_ms, step=i)
+    telemetry.observe("train.step_ms", wall_ms)
+    events.inc("work.steps")
+
+fleet.publish()
+mp.barrier("tt-fleet-published")
+if PID == 0:
+    snap = fleet.fleet_snapshot()
+    hist = snap["histograms"]["train.step_ms"]
+    emit(host=PID, n_hosts=snap["n_hosts"],
+         work_steps=snap["counters"]["work.steps"],
+         p99=hist["p99"], hist_count=hist["count"],
+         stragglers=snap["stragglers"])
+    # a synthetic preempted request so the shard files carry a full trace
+    t = tracing.new_trace_id()
+    tracing.trace_event(t, "submitted", request=0, lane="interactive")
+    tracing.trace_event(t, "admitted", request=0, queued_ms=1.2)
+    tracing.trace_event(t, "prefill", request=0, dur_ms=3.0, tokens=9)
+    tracing.trace_step([t], "decode", dur_ms=1.0, step=0)
+    tracing.trace_event(t, "preempted", request=0)
+    tracing.trace_event(t, "resumed", request=0)
+    tracing.trace_event(t, "retired", request=0, finish="length")
+emit(host=PID, med=flight_recorder.recorder().rolling_median(),
+     state=telemetry.histogram("train.step_ms").state())
+mp.barrier("tt-fleet-done")
+"""
+
+
+def _records_by_host(results):
+    out = {}
+    for r in results:
+        for rec in r.records:
+            out.setdefault(rec.get("host", r.proc), []).append(rec)
+    return out
+
+
+def _one(records, host, key):
+    recs = [r for r in records.get(host, ()) if key in r]
+    assert recs, f"host {host} emitted no record with {key!r}"
+    return recs[-1][key]
+
+
+@pytest.mark.slow
+@pytest.mark.dist
+class TestFleetTwoHosts:
+    def test_merge_straggler_and_trace_under_real_cluster(self, tmp_path):
+        """ISSUE 17 acceptance, on a real 2-process jax cluster: merged
+        counters, exact-merge fleet percentiles, the TT_FAULT `slow` host
+        flagged as a straggler with a named cause, per-process TT_OBS_FILE
+        shards, and the CLI trace render over those shards."""
+        from thunder_tpu.parallel.multiprocess import LocalCluster
+
+        obs_path = str(tmp_path / "run.jsonl")
+        results = LocalCluster(nprocs=2).run(FLEET_WORKER, env={
+            "TT_OBS_FILE": obs_path,
+            "TT_FAULT": "slow(30)@0*24:host=1",
+        })
+        assert all(r.ok for r in results), results
+        by_host = _records_by_host(results)
+
+        # satellite: the export path auto-sharded per process index
+        shards = [str(tmp_path / "run.p0.jsonl"), str(tmp_path / "run.p1.jsonl")]
+        for s in shards:
+            assert os.path.exists(s), s
+        assert not os.path.exists(obs_path)  # never the unsharded path
+
+        # merged counters: both hosts' 24 steps
+        assert _one(by_host, 0, "n_hosts") == 2
+        assert _one(by_host, 0, "work_steps") == 48
+
+        # host 1 (the slow(30) target) flagged, with the injected cause
+        strag = _one(by_host, 0, "stragglers")
+        assert [s["host"] for s in strag] == [1]
+        assert strag[0]["cause"] == "data-stall"
+        assert strag[0]["ratio"] > 2.0
+        assert _one(by_host, 1, "med") > 2.0 * _one(by_host, 0, "med")
+
+        # fleet percentiles are EXACTLY the bucket-wise merge of the two
+        # hosts' raw states (not averaged): rebuild offline and compare
+        merged = StreamingHistogram.from_states(
+            [_one(by_host, 0, "state"), _one(by_host, 1, "state")])
+        assert merged.count == _one(by_host, 0, "hist_count") == 48
+        assert round(merged.quantile(0.99), 3) == _one(by_host, 0, "p99")
+
+        # the CLI readers work over the raw shard files
+        recs = obs_summary.load_many(shards)
+        text = obs_summary.render_trace(recs, "0")
+        for needle in ("submitted", "preempted", "resumed", "retired"):
+            assert needle in text
+        flt = "\n".join(obs_summary.fleet_lines(
+            recs, obs_summary.final_counters(recs)))
+        assert "STRAGGLER" in flt and "cause=data-stall" in flt

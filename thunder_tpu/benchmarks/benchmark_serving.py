@@ -40,6 +40,13 @@ Workloads:
   serve.spec_proposed) from post-warmup counters; both gate
   higher-is-better in tools/perf_gate.py.
 
+Every row also reports ``obs_overhead_us`` — the measured disabled-path
+cost of per-request tracing (tracing.disabled_overhead_us(); gated
+lower-is-better) — plus the ``trace_counters`` family and a ``fleet``
+block (per-host step stats + straggler flags from
+observability.fleet_snapshot(), single-host degenerate here but the same
+merge path a multi-host run aggregates through).
+
 Usage:
     python -m thunder_tpu.benchmarks.benchmark_serving --model_name tiny-llama2 \
         --streams 8 --page_size 16 --arrival_rate 16
@@ -198,7 +205,14 @@ def run(args) -> dict:
     wall = time.perf_counter() - t0
 
     counters = observability.counters()
+    # fleet view over this (single-host) run: merged counters + per-host step
+    # stats from the same snapshot/merge path a multi-host aggregation uses
+    fleet_snap = observability.fleet_snapshot()
     observability.disable()
+    # disabled-path cost of request tracing, measured with the bus OFF (the
+    # state the key gates): min-of-repeats microbench, see perf_gate.py
+    from thunder_tpu.observability import tracing as _tracing
+    obs_overhead_us = _tracing.disabled_overhead_us()
     recompiles = sum(v for k, v in counters.items() if k.startswith("recompile."))
 
     import jax
@@ -235,7 +249,19 @@ def run(args) -> dict:
         "decode_steps": stats["decode_steps"],
         "peak_page_pool_utilization": stats["peak_page_pool_utilization"],
         "recompiles_steady_state": int(recompiles),
+        "obs_overhead_us": round(obs_overhead_us, 3),
         "serve_counters": {k: v for k, v in counters.items() if k.startswith("serve.")},
+        # request-tracing traffic only: the specialization cache is ALSO
+        # named "trace", so exclude its hit/miss/evict outcome counters
+        "trace_counters": {k: v for k, v in counters.items()
+                           if k.startswith("trace.")
+                           and k.partition(".")[2] not in ("hit", "miss", "evict")},
+        "fleet": {
+            "n_hosts": fleet_snap.get("n_hosts"),
+            "hosts": {str(h): info.get("steps")
+                      for h, info in fleet_snap.get("hosts", {}).items()},
+            "stragglers": fleet_snap.get("stragglers", []),
+        },
     }
     if args.workload == "mixed":
         n_req = counters.get("serve.requests", 0)

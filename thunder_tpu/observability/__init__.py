@@ -54,13 +54,17 @@ from .runtime import (  # noqa: F401
     step_sampled,
     step_span,
 )
+from . import fleet  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import profiler  # noqa: F401
 from . import slo  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import tracing  # noqa: F401
+from .fleet import StragglerDetector, fleet_snapshot, incidents  # noqa: F401
 from .flight_recorder import install_crash_hook, uninstall_crash_hook  # noqa: F401
 from .slo import SLOMonitor, SLOPolicy  # noqa: F401
+from .tracing import chrome_trace, new_trace_id, trace_event, trace_step  # noqa: F401
 from .telemetry import (  # noqa: F401
     MetricsExporter,
     StreamingHistogram,

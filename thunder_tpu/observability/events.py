@@ -77,10 +77,42 @@ class _Bus:
 _BUS = _Bus()
 
 
+def _proc_shard_path(path: str) -> str:
+    """In a multi-process run, suffix the export path with the process index
+    (``trace.jsonl`` → ``trace.p1.jsonl``): two hosts appending to one file
+    interleave half-written lines. tools/obs_summary.py already namespaces
+    multiple shard files per invocation, so readers just pass every shard.
+    Process identity comes from the TT_MP_* harness env first, then from an
+    already-imported jax (never imported here — enable() runs at import)."""
+    import sys
+
+    proc = os.environ.get("TT_MP_PROC")
+    nprocs = os.environ.get("TT_MP_NPROCS")
+    try:
+        if proc is None or nprocs is None or int(nprocs) <= 1:
+            proc = None
+    except ValueError:
+        proc = None
+    if proc is None and "jax" in sys.modules:
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                proc = str(jax.process_index())
+        except Exception:  # noqa: BLE001 - uninitialized backend: single shard
+            proc = None
+    if proc is None:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{proc}{ext}"
+
+
 def enable(path: Optional[str] = None, *, append: bool = False) -> None:
-    """Turn recording on; ``path`` streams records as JSON lines."""
+    """Turn recording on; ``path`` streams records as JSON lines (suffixed
+    per process index in multi-process runs — see ``_proc_shard_path``)."""
     with _BUS.lock:
         if path:
+            path = _proc_shard_path(path)
             if _BUS.file is not None:
                 try:
                     _BUS.file.close()
@@ -111,14 +143,19 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Clear recorded state (tests; keeps enabled/export settings). Also
-    clears the live-telemetry registry (histograms/gauges) so one reset
-    clears everything recorded."""
+    clears the live-telemetry registry (histograms/gauges), the flight
+    recorder's ring + spike state, and every live SLO monitor's sliding
+    windows, so one reset between benchmark phases leaves no stale spike/
+    breach state to pollute the next phase's incident view."""
     with _BUS.lock:
         _BUS.records.clear()
         _BUS.counters.clear()
-    from . import telemetry  # deferred: telemetry imports this module
+    # deferred: these modules import this one
+    from . import flight_recorder, slo, telemetry
 
     telemetry.reset()
+    flight_recorder.reset()
+    slo.reset_windows()
 
 
 def records() -> list[dict]:

@@ -1,0 +1,365 @@
+"""Fleet observability: cross-host aggregation, stragglers, incidents.
+
+Every other observability surface is per-process; this module is the
+cross-host view, built on two well-trodden designs:
+
+* **mergeable sketches** — ``StreamingHistogram`` is log-bucketed
+  (DDSketch), so two hosts with the same ``alpha`` share one bucket-index
+  space and a bucket-wise sum of their count maps IS the histogram a
+  single process fed both streams would hold. Fleet p99s are therefore
+  exact to the estimator's tolerance — never averages-of-percentiles.
+* **coordination-KV snapshot exchange** — each host periodically publishes
+  a compact JSON snapshot (counters, gauges, raw histogram bucket states,
+  step-time stats + flight-recorder cause counts) under
+  ``tt_fleet/snap/<host>/<seq>`` in the distributed runtime's KV store
+  (parallel/multiprocess.py), deleting its previous key. Any host — in
+  practice host 0, or the fleet-mode MetricsExporter on each scrape —
+  collects the latest snapshot per host with one dir-get and merges.
+
+``fleet_snapshot()`` is the entry point: publish own → collect all →
+merge, plus straggler evaluation. Single-process it degrades to a
+one-host view of the local state, so the same code path is testable (and
+scrapable) everywhere.
+
+**Straggler detection**: per-host step wall-times (the flight recorder's
+rolling median) ride the snapshots; a host whose median exceeds
+``factor``× the fleet median (the lower median of host medians — with an
+even host count this biases toward flagging, the safe direction) is
+flagged with a reason code cross-referenced from that host's
+flight-recorder causes (recompile / data-stall / host-overhead /
+checkpoint-save / guard-intervention). Flagging is transition-deduped like
+SLO breaches: one ``straggler`` event + ``fleet.straggler`` counter per
+onset, ``straggler.recovered`` on the way back.
+
+**Incident correlation**: ``incidents()`` joins each ``slo.breach`` on the
+local timeline with contemporaneous evidence — step spikes (with their
+triaged causes), recompiles, pool-pressure readings from serving events,
+and straggler flags — into one reason-ranked report per breach.
+
+Zero-work-when-disabled: nothing here sits on a hot path — snapshots,
+merges, and detection run at scrape/poll cadence — and every recording
+helper it calls is itself bus-gated.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from typing import Optional
+
+from . import events as _events
+from . import flight_recorder as _flight
+from . import telemetry as _tel
+
+KV_PREFIX = "tt_fleet"
+
+STRAGGLER_FACTOR = 2.0     # host median > factor × fleet median → straggler
+STRAGGLER_MIN_STEPS = 8    # don't judge a cold window
+
+_seq = itertools.count(1)
+_prev_key: Optional[str] = None
+_pub_lock = threading.Lock()
+
+
+def _mp():
+    # deferred: parallel/__init__ pulls in mesh/jax machinery this module
+    # must not load at import time
+    from ..parallel import multiprocess
+
+    return multiprocess
+
+
+# -- per-host snapshot -------------------------------------------------------
+
+
+def host_snapshot() -> dict:
+    """This host's compact publishable state: counters, set gauges, RAW
+    histogram bucket states (the mergeable form), and step-time stats with
+    flight-recorder cause counts for straggler triage."""
+    mp = _mp()
+    rec = _flight.recorder()
+    stats = rec.stats()
+    steps = None
+    if stats is not None:
+        steps = {
+            "count": stats["count"],
+            "median_ms": rec.rolling_median(),
+            "p99_ms": stats["p99_ms"],
+            "max_ms": stats["max_ms"],
+            "spikes": stats["spikes"],
+            "causes": rec.cause_counts(),
+        }
+    return {
+        "host": mp.process_index(),
+        "ts_ms": round(_events._BUS.now_ms(), 3),
+        "counters": _events.counters(),
+        "gauges": dict(_tel._gauges),
+        "hists": _tel.histogram_states(),
+        "steps": steps,
+    }
+
+
+def publish() -> dict:
+    """Publish this host's snapshot to the coordination KV (latest-wins via
+    a per-host sequence key; the previous key is deleted so dir-get stays
+    one entry per host). Outside a multi-process run this is a no-op
+    beyond building the snapshot, which is returned either way."""
+    global _prev_key
+    snap = host_snapshot()
+    mp = _mp()
+    if mp.coordinator_client() is None or mp.process_count() <= 1:
+        return snap
+    with _pub_lock:
+        key = f"{KV_PREFIX}/snap/{snap['host']}/{next(_seq):08d}"
+        mp.kv_set(key, json.dumps(snap))
+        if _prev_key is not None:
+            mp.kv_delete(_prev_key)
+        _prev_key = key
+    return snap
+
+
+def collect() -> dict[int, dict]:
+    """Latest published snapshot per host ({host: snapshot}), this host's
+    taken live. Single-process: just the local view."""
+    mp = _mp()
+    me = mp.process_index()
+    if mp.coordinator_client() is None or mp.process_count() <= 1:
+        return {me: host_snapshot()}
+    latest: dict[int, tuple[int, dict]] = {}
+    for key, value in mp.kv_dir(f"{KV_PREFIX}/snap/"):
+        parts = key.rsplit("/", 2)
+        if len(parts) != 3:
+            continue
+        try:
+            host, seq = int(parts[1]), int(parts[2])
+            snap = json.loads(value)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        if host not in latest or seq > latest[host][0]:
+            latest[host] = (seq, snap)
+    out = {h: s for h, (_, s) in latest.items()}
+    out[me] = host_snapshot()
+    return out
+
+
+# -- merge -------------------------------------------------------------------
+
+
+def merge(snaps: dict[int, dict]) -> dict:
+    """Merge per-host snapshots: counters sum, histograms merge bucket-wise
+    (exact — see module docstring), per-host detail is kept under
+    ``hosts`` so readers can still split any series by host."""
+    counters: dict[str, int] = {}
+    hist_states: dict[str, list[dict]] = {}
+    hosts: dict[int, dict] = {}
+    for h, s in sorted(snaps.items()):
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for name, st in (s.get("hists") or {}).items():
+            hist_states.setdefault(name, []).append(st)
+        hosts[h] = {"ts_ms": s.get("ts_ms"),
+                    "counters": s.get("counters") or {},
+                    "gauges": s.get("gauges") or {},
+                    "steps": s.get("steps")}
+    merged_hists = {name: _tel.StreamingHistogram.from_states(states)
+                    for name, states in hist_states.items()}
+    return {
+        "n_hosts": len(snaps),
+        "counters": counters,
+        "histograms": {n: h.snapshot() for n, h in sorted(merged_hists.items())},
+        "_merged_hists": merged_hists,   # live objects for exporters/tests
+        "hosts": hosts,
+        "stragglers": [],
+    }
+
+
+# -- straggler detection -----------------------------------------------------
+
+
+class StragglerDetector:
+    """Flags hosts whose rolling step median exceeds ``factor``× the fleet
+    median, naming the dominant flight-recorder cause. Stateful for
+    transition dedup: a host is announced once per onset, not per poll."""
+
+    def __init__(self, factor: float = STRAGGLER_FACTOR,
+                 min_steps: int = STRAGGLER_MIN_STEPS):
+        self.factor = factor
+        self.min_steps = min_steps
+        self._flagged: dict[int, bool] = {}
+
+    def evaluate(self, snaps: dict[int, dict]) -> list[dict]:
+        meds = {}
+        for h, s in snaps.items():
+            st = s.get("steps")
+            if st and st.get("median_ms") is not None \
+                    and st.get("count", 0) >= self.min_steps:
+                meds[h] = float(st["median_ms"])
+        if len(meds) < 2:
+            return []
+        # lower median of host medians: with an even host count the upper
+        # median would sit ON the slow half and mask it
+        xs = sorted(meds.values())
+        fleet_med = xs[(len(xs) - 1) // 2]
+        out = []
+        for h, m in sorted(meds.items()):
+            is_straggler = fleet_med > 0 and m > self.factor * fleet_med
+            was = self._flagged.get(h, False)
+            if is_straggler:
+                causes = (snaps[h].get("steps") or {}).get("causes") or {}
+                cause = max(causes, key=causes.get) if causes else "unknown"
+                rec = {"host": h, "median_ms": round(m, 3),
+                       "fleet_median_ms": round(fleet_med, 3),
+                       "ratio": round(m / fleet_med, 2), "cause": cause}
+                out.append(rec)
+                if not was:
+                    from . import metrics as _metrics
+
+                    _metrics.record_straggler(**rec)
+            elif was:
+                _events.event("straggler.recovered", host=h,
+                              median_ms=round(m, 3),
+                              fleet_median_ms=round(fleet_med, 3))
+            self._flagged[h] = is_straggler
+        return out
+
+
+_DETECTOR = StragglerDetector()
+
+
+def detector() -> StragglerDetector:
+    return _DETECTOR
+
+
+# -- entry point -------------------------------------------------------------
+
+
+def fleet_snapshot(*, publish_first: bool = True, detect: bool = True) -> dict:
+    """The merged cross-host view: publish this host's snapshot, collect
+    every host's latest, merge counters/gauges/histograms bucket-wise, and
+    (by default) run straggler detection over the per-host step medians.
+
+    Returns {"n_hosts", "counters", "histograms", "hosts", "stragglers"}.
+    Works — as a one-host view — in single-process runs too."""
+    if publish_first:
+        publish()
+    snaps = collect()
+    out = merge(snaps)
+    if detect:
+        out["stragglers"] = _DETECTOR.evaluate(snaps)
+    return out
+
+
+# -- fleet Prometheus rendering ----------------------------------------------
+
+
+def render_prometheus_fleet() -> str:
+    """The fleet-mode scrape body: every counter/gauge as per-host samples
+    with a ``host`` label plus a ``host="fleet"`` aggregate (sum for
+    counters); histograms as the bucket-wise-merged fleet series. Served by
+    ``MetricsExporter(..., fleet=True)``."""
+    snap = fleet_snapshot()
+    lines: list[str] = []
+    names: dict[str, list[tuple[str, float]]] = {}
+    kinds: dict[str, str] = {}
+    for h, info in sorted(snap["hosts"].items()):
+        for k, v in sorted(info["counters"].items()):
+            names.setdefault(k, []).append((str(h), v))
+            kinds[k] = "counter"
+        for k, v in sorted(info["gauges"].items()):
+            if kinds.get(k) == "counter":
+                continue  # a counter family claimed this name (TYPE dedup)
+            names.setdefault(k, []).append((str(h), v))
+            kinds.setdefault(k, "gauge")
+    for k in sorted(names):
+        p = _tel._prom_name(k)
+        lines.append(f"# TYPE {p} {kinds[k]}")
+        for host, v in names[k]:
+            lines.append(f'{p}{{host="{host}"}} {_tel._prom_num(v)}')
+        if kinds[k] == "counter":
+            lines.append(f'{p}{{host="fleet"}} '
+                         f'{_tel._prom_num(snap["counters"].get(k, 0))}')
+    for name, h in sorted(snap.get("_merged_hists", {}).items()):
+        p = _tel._prom_name(name)
+        lines.append(f"# TYPE {p} histogram")
+        for le, cum in h.buckets():
+            lines.append(f'{p}_bucket{{host="fleet",le="{_tel._prom_num(le)}"}} {cum}')
+        lines.append(f'{p}_bucket{{host="fleet",le="+Inf"}} {h.count}')
+        lines.append(f'{p}_sum{{host="fleet"}} {_tel._prom_num(h.sum)}')
+        lines.append(f'{p}_count{{host="fleet"}} {h.count}')
+    return "\n".join(lines) + "\n"
+
+
+# -- incident correlation ----------------------------------------------------
+
+# evidence weights for cause ranking: a contemporaneous recompile almost
+# always IS the story; pool pressure is a symptom more than a cause
+_EVIDENCE_WEIGHT = {"recompile": 4.0, "straggler": 3.0, "spike": 2.0,
+                    "pool-pressure": 1.0}
+_POOL_PRESSURE = 0.9   # pool_utilization at/above this counts as pressure
+
+
+def incidents(*, window_ms: float = 2000.0,
+              records: Optional[list] = None) -> list[dict]:
+    """Join every ``slo.breach`` on the timeline with contemporaneous
+    evidence — step spikes (and their triaged causes), recompile events,
+    pool-pressure readings carried on serving events, straggler flags —
+    into one reason-ranked incident each.
+
+    Each incident: {"ts_ms", "reason", "source", "value", "target",
+    "likely_causes": [(cause, score), ...] ranked, "evidence": {...}}.
+    Pass ``records`` to correlate a replayed timeline (obs_summary does);
+    default is the live bus."""
+    recs = _events.records() if records is None else records
+    evs = [r for r in recs if r.get("kind") == "event"]
+    breaches, spikes, recompiles, stragglers, pressure = [], [], [], [], []
+    for r in evs:
+        name, attrs = r.get("name"), r.get("attrs") or {}
+        if name == "slo.breach":
+            breaches.append(r)
+        elif name == "step_spike":
+            spikes.append(r)
+        elif name == "recompile":
+            recompiles.append(r)
+        elif name == "straggler":
+            stragglers.append(r)
+        elif (attrs.get("pool_utilization") or 0) >= _POOL_PRESSURE:
+            pressure.append(r)
+    out = []
+    for b in breaches:
+        t = b.get("ts_ms", 0.0)
+
+        def near(rs):
+            return [r for r in rs if abs(r.get("ts_ms", 0.0) - t) <= window_ms]
+
+        ev = {"spikes": near(spikes), "recompiles": near(recompiles),
+              "stragglers": near(stragglers), "pool_pressure": near(pressure)}
+        scores: dict[str, float] = {}
+
+        def add(cause, weight):
+            scores[cause] = scores.get(cause, 0.0) + weight
+
+        for r in ev["recompiles"]:
+            add("recompile", _EVIDENCE_WEIGHT["recompile"])
+        for r in ev["stragglers"]:
+            a = r.get("attrs") or {}
+            add(f"straggler-host-{a.get('host', '?')}"
+                + (f"-{a['cause']}" if a.get("cause") else ""),
+                _EVIDENCE_WEIGHT["straggler"])
+        for r in ev["spikes"]:
+            a = r.get("attrs") or {}
+            add(f"spike-{a.get('cause', 'unknown')}",
+                _EVIDENCE_WEIGHT["spike"])
+        for r in ev["pool_pressure"]:
+            add("pool-pressure", _EVIDENCE_WEIGHT["pool-pressure"])
+        a = b.get("attrs") or {}
+        out.append({
+            "ts_ms": t,
+            "reason": a.get("reason"),
+            "source": a.get("source"),
+            "value": a.get("value"),
+            "target": a.get("target"),
+            "likely_causes": sorted(scores.items(),
+                                    key=lambda kv: (-kv[1], kv[0])),
+            "evidence": {k: len(v) for k, v in ev.items()},
+        })
+    return out

@@ -129,6 +129,49 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def rolling_median(self) -> Optional[float]:
+        """Median over the rolling window (any sample count, unlike the
+        spike gate's SPIKE_MIN_SAMPLES floor) — the per-host step statistic
+        that rides fleet snapshots for straggler detection (fleet.py)."""
+        with self._lock:
+            if not self._durs:
+                return None
+            xs = sorted(self._durs)
+            return xs[len(xs) // 2]
+
+    def cause_counts(self, limit: int = 256) -> dict[str, int]:
+        """Histogram of likely-cause codes over recent evidence: every
+        recorded spike's triaged cause PLUS cause-indicating bus events
+        (recompile / data_stall / checkpoint_save / guard) in the last
+        ``limit`` records. The second source matters for straggler triage:
+        a UNIFORMLY slow host (its own median shifts with it) never spikes,
+        so only the raw events name what it keeps paying for."""
+        counts: dict[str, int] = {}
+
+        def bump(code: str) -> None:
+            counts[code] = counts.get(code, 0) + 1
+
+        with self._lock:
+            spike_causes = [r["spike"].get("cause", "unknown")
+                            for r in self._ring if "spike" in r]
+        for c in spike_causes:
+            bump(c)
+        for r in _obs.records()[-limit:]:
+            if r.get("kind") != "event":
+                continue
+            name = r.get("name")
+            if name == "recompile":
+                bump("recompile")
+            elif name in ("data_stall", "prefetch_stall"):
+                bump("data-stall")
+            elif name == "checkpoint_save":
+                bump("checkpoint-save")
+            elif name == "guard":
+                bump("guard-intervention")
+            elif name == "host_overhead":
+                bump("host-overhead")
+        return counts
+
     def stats(self) -> Optional[dict]:
         with self._lock:
             durs = sorted(r["wall_ms"] for r in self._ring)

@@ -218,6 +218,20 @@ def record_slo_breach(reason: str, **attrs) -> None:
     events.event("slo.breach", reason=reason, **attrs)
 
 
+def record_straggler(host: int, cause: str, **attrs) -> None:
+    """A host crossed the fleet straggler threshold (fleet.py; transition-
+    deduped by the detector, so one onset = one event). Counter
+    ``fleet.straggler`` + a reason-coded ``straggler`` timeline event
+    carrying host/median_ms/fleet_median_ms/ratio — the cause code comes
+    from that host's flight-recorder triage vocabulary (recompile /
+    data-stall / checkpoint-save / host-overhead / guard-intervention /
+    unknown)."""
+    if not events.enabled():
+        return
+    events.inc("fleet.straggler")
+    events.event("straggler", host=int(host), cause=cause, **attrs)
+
+
 def record_serve(outcome: str, delta: int = 1, event: bool = False, **attrs) -> None:
     """Serving-engine traffic: bumps ``serve.<outcome>`` and, for the
     low-rate lifecycle outcomes (admission/retirement), records a

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
@@ -38,6 +39,23 @@ from typing import Optional
 from . import events as _events
 from . import metrics as _metrics
 from .telemetry import percentile as _pct
+
+# every live monitor, weakly held, so events.reset() can clear all sliding
+# windows without owning references to engine/trainer internals
+_registry_lock = threading.Lock()
+_monitors: "weakref.WeakSet[SLOMonitor]" = weakref.WeakSet()
+
+
+def reset_windows() -> None:
+    """Clear every live monitor's sliding windows and breach state (policy
+    and source stay). events.reset() calls this so a reset between
+    benchmark phases doesn't carry one phase's breach latches — and the
+    breach-transition counts they'd re-emit — into the next phase's
+    incident view."""
+    with _registry_lock:
+        monitors = list(_monitors)
+    for m in monitors:
+        m.reset_window()
 
 BREACH_P99_TTFT = "p99-ttft"
 BREACH_P99_TBOT = "p99-tbot"
@@ -112,6 +130,21 @@ class SLOMonitor:
         # state — evaluates immediately, so transition latency stays at one
         # sample where it matters
         self._eval_every = max(1, policy.min_samples // 4)
+        with _registry_lock:
+            _monitors.add(self)
+
+    def reset_window(self) -> None:
+        """Drop the sliding windows and breach latches (module
+        ``reset_windows()`` fans this out to every live monitor)."""
+        with self._lock:
+            self._ttft.clear()
+            self._tbot.clear()
+            self._step.clear()
+            self._met.clear()
+            self._tok.clear()
+            self._breached.clear()
+            self.breaches = 0
+            self._n_obs = 0
 
     # -- recording ---------------------------------------------------------
 

@@ -164,6 +164,66 @@ class StreamingHistogram:
         with self._lock:
             return len(self._counts) + (1 if self._zero else 0)
 
+    # -- fleet merge (observability/fleet.py) --------------------------------
+    #
+    # Two histograms with the same alpha share one bucket-index space
+    # (i = ceil(log_gamma(v))), so adding their count maps produces EXACTLY
+    # the map a single histogram fed both streams would hold — fleet
+    # percentiles are merge-exact, not averages-of-percentiles
+    # (tests/test_fleet.py pins the identity).
+
+    def state(self) -> dict:
+        """JSON-serializable raw state for cross-host merging: alpha, the
+        sparse bucket-count map, the zero bucket, and the exact count/sum/
+        min/max moments."""
+        with self._lock:
+            return {
+                "alpha": self.alpha,
+                "counts": {str(i): c for i, c in self._counts.items()},
+                "zero": self._zero,
+                "count": self.count,
+                "sum": self.sum,
+                "min": None if self.count == 0 else self.min,
+                "max": None if self.count == 0 else self.max,
+            }
+
+    def merge_state(self, state: dict) -> None:
+        """Fold another histogram's ``state()`` into this one bucket-wise.
+        Requires an identical ``alpha`` (same gamma, same index space) —
+        merging across accuracy settings would silently misbucket."""
+        alpha = float(state["alpha"])
+        if abs(alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge histograms with different alpha: "
+                f"{alpha} != {self.alpha}")
+        with self._lock:
+            for k, c in state.get("counts", {}).items():
+                i = int(k)
+                self._counts[i] = self._counts.get(i, 0) + int(c)
+            self._zero += int(state.get("zero", 0))
+            self.count += int(state.get("count", 0))
+            self.sum += float(state.get("sum", 0.0))
+            if state.get("min") is not None:
+                self.min = min(self.min, float(state["min"]))
+            if state.get("max") is not None:
+                self.max = max(self.max, float(state["max"]))
+            while len(self._counts) > self.max_buckets:
+                lo = sorted(self._counts)[:2]
+                self._counts[lo[1]] += self._counts.pop(lo[0])
+
+    @classmethod
+    def from_states(cls, states, max_buckets: int = 1024) -> "StreamingHistogram":
+        """Rebuild one histogram from per-host ``state()`` dicts (bucket-wise
+        sum). The result is bit-identical to a single histogram that observed
+        every host's stream, modulo float summation order in ``sum``."""
+        states = list(states)
+        if not states:
+            return cls()
+        h = cls(alpha=float(states[0]["alpha"]), max_buckets=max_buckets)
+        for st in states:
+            h.merge_state(st)
+        return h
+
 
 # -- process-global registry -------------------------------------------------
 
@@ -191,6 +251,12 @@ def histogram(name: str) -> Optional[StreamingHistogram]:
 
 def histogram_snapshots() -> dict[str, dict]:
     return {name: h.snapshot() for name, h in sorted(_hists.items())}
+
+
+def histogram_states() -> dict[str, dict]:
+    """Raw per-series bucket states for fleet snapshots (fleet.py): the
+    mergeable representation, not the summarized one."""
+    return {name: h.state() for name, h in sorted(_hists.items())}
 
 
 def set_gauge(name: str, value: float) -> None:
@@ -297,11 +363,21 @@ class MetricsExporter:
     target: an int / digit-string is a TCP port to serve ``GET /metrics``
     on (0 binds an ephemeral port — read ``.port`` back); anything else is
     a file path atomically rewritten every ``interval`` seconds (for
-    node-exporter textfile collection or plain tailing)."""
+    node-exporter textfile collection or plain tailing).
 
-    def __init__(self, target: Union[int, str], interval: float = 2.0):
+    ``fleet=True`` serves the merged cross-host view instead of the local
+    one: each scrape publishes this host's snapshot through the
+    coordination KV, collects every host's latest, and renders merged
+    ``tt_*`` series carrying a ``host`` label (per-host samples plus a
+    ``host="fleet"`` bucket-wise-merged aggregate — fleet.py). Falls back
+    to the local render if the merge fails mid-run (a peer died), so a
+    scrape never comes back empty."""
+
+    def __init__(self, target: Union[int, str], interval: float = 2.0,
+                 fleet: bool = False):
         self.target = target
         self.interval = interval
+        self.fleet = fleet
         self.port: Optional[int] = None
         self.path: Optional[str] = None
         self._server = None
@@ -316,12 +392,23 @@ class MetricsExporter:
             self._start_file(str(t))
         return self
 
+    def _render(self) -> str:
+        if self.fleet:
+            from . import fleet as _fleet  # deferred: fleet imports this module
+            try:
+                return _fleet.render_prometheus_fleet()
+            except Exception:  # noqa: BLE001 - a dead peer or KV hiccup must
+                # not blank the scrape; serve the local view instead
+                pass
+        return render_prometheus()
+
     def _start_http(self, port: int) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        exporter_self = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(handler):  # noqa: N805 - stdlib handler convention
-                body = render_prometheus().encode()
+                body = exporter_self._render().encode()
                 handler.send_response(200)
                 handler.send_header("Content-Type",
                                     "text/plain; version=0.0.4; charset=utf-8")
@@ -357,7 +444,7 @@ class MetricsExporter:
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
-                f.write(render_prometheus())
+                f.write(self._render())
             os.replace(tmp, self.path)  # atomic: a scraper never reads half
         except OSError:
             try:
@@ -381,15 +468,17 @@ class MetricsExporter:
 _exporter: Optional[MetricsExporter] = None
 
 
-def start_exporter(target: Union[int, str], *,
-                   interval: float = 2.0) -> MetricsExporter:
+def start_exporter(target: Union[int, str], *, interval: float = 2.0,
+                   fleet: bool = False) -> MetricsExporter:
     """Start (or replace) the process-global exporter; also enables the bus
-    — an exporter over a disabled bus would scrape empty forever."""
+    — an exporter over a disabled bus would scrape empty forever.
+    ``fleet=True`` (or TT_OBS_EXPORT_FLEET=1 for the env-driven start)
+    serves the merged cross-host view with ``host`` labels."""
     global _exporter
     stop_exporter()
     if not events.enabled():
         events.enable()
-    _exporter = MetricsExporter(target, interval=interval).start()
+    _exporter = MetricsExporter(target, interval=interval, fleet=fleet).start()
     return _exporter
 
 
@@ -412,7 +501,8 @@ atexit.register(stop_exporter)
 _env_export = os.environ.get("TT_OBS_EXPORT")
 if _env_export:
     try:
-        start_exporter(_env_export)
+        start_exporter(_env_export, fleet=os.environ.get(
+            "TT_OBS_EXPORT_FLEET", "").lower() in ("1", "true", "yes", "on"))
     except Exception as e:  # noqa: BLE001 - port in use, bad port (>65535
         # raises OverflowError, not OSError), unwritable path: telemetry
         # must never take the importing process down

@@ -143,6 +143,32 @@ def kv_get(key: str, *, timeout_s: float = 60.0) -> str:
     return client.blocking_key_value_get(key, int(timeout_s * 1000))
 
 
+def kv_delete(key: str) -> None:
+    """Best-effort delete (retiring a superseded published snapshot —
+    observability/fleet.py); a missing key or an old runtime without
+    delete support is fine."""
+    client = coordinator_client()
+    if client is not None:
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+
+
+def kv_dir(prefix: str) -> list[tuple[str, str]]:
+    """Every (key, value) currently published under ``prefix`` (full key
+    paths, the runtime's dir-get). Empty outside a multi-process run — and
+    on a runtime hiccup, so pollers (fleet snapshot collection) degrade to
+    their local view instead of raising mid-scrape."""
+    client = coordinator_client()
+    if client is None:
+        return []
+    try:
+        return list(client.key_value_dir_get(prefix))
+    except Exception:
+        return []
+
+
 def kv_agree(tag: str, value: str, *, timeout_s: float = 60.0) -> dict[int, str]:
     """Publish this host's ``value`` under ``tag`` and collect every host's.
     Returns {process_index: value}; raises TimeoutError (from the runtime)

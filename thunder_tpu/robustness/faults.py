@@ -20,6 +20,16 @@ Distributed runs add two things (ISSUE 14): a fifth kind and a host scope:
               no atexit, no finally, no final checkpoint; the real shape of
               a host lost mid-step (exercises kill-one-host-and-resume)
 
+Fleet observability (ISSUE 17) adds a sixth, non-destructive kind:
+
+  slow        sleep ``ms`` milliseconds at the step boundary — a deterministic
+              stand-in for a straggling host (slow input pipeline, noisy
+              neighbor, thermal throttle). ``slow(30)@0*24:host=1`` makes
+              host 1 ~30 ms/step slower for 24 steps. Each firing emits a
+              ``data_stall`` event on the bus (when enabled) so the fleet
+              straggler detector can name the cause, exercising the
+              detect-and-triage path end to end.
+
   ``:host=<p>`` scopes any fault to one process of a multi-process run
   (``nan_loss@5:host=1`` poisons only host 1's batch — the psum'd guard
   gate must still skip the step on EVERY host). Unscoped faults fire on
@@ -37,6 +47,8 @@ Enablement:
 makes it fire at ``count`` consecutive opportunities starting there
 (``nan_loss@5*3`` poisons steps 5,6,7; ``transient@5*2`` fails the first two
 dispatch attempts of step 5 — retries within one step re-consult the plan).
+Kinds that take a parameter write it in parens: ``slow(30)@0*10`` (the
+argument defaults per kind — 50 ms for ``slow``).
 
 Zero-overhead discipline: with no plan configured (the default), the hot-path
 check is a single module-global ``is None`` test (``active()``), mirroring the
@@ -50,7 +62,10 @@ from typing import Optional
 
 import numpy as np
 
-KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt", "die")
+KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt", "die", "slow")
+
+# default per-step delay for a bare `slow@N` fault (no explicit `(ms)` arg)
+DEFAULT_SLOW_MS = 50.0
 
 # exit status of an injected `die` fault: distinct from every python/pytest
 # code so the multi-process harness can assert the host died BY INJECTION
@@ -66,25 +81,29 @@ class InjectedCheckpointError(OSError):
 
 
 class _Fault:
-    __slots__ = ("kind", "step", "count", "fired", "host")
+    __slots__ = ("kind", "step", "count", "fired", "host", "arg")
 
     def __init__(self, kind: str, step: int, count: int = 1,
-                 host: Optional[int] = None):
+                 host: Optional[int] = None, arg: Optional[float] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; expected one of {KINDS}")
         if step < 0 or count < 1:
             raise ValueError(f"fault {kind}@{step}*{count}: step must be >= 0, count >= 1")
         if host is not None and host < 0:
             raise ValueError(f"fault {kind}@{step}: host index must be >= 0, got {host}")
+        if arg is not None and arg < 0:
+            raise ValueError(f"fault {kind}@{step}: argument must be >= 0, got {arg}")
         self.kind = kind
         self.step = step
         self.count = count
         self.fired = 0
         self.host = host
+        self.arg = arg
 
     def __repr__(self) -> str:
+        param = "" if self.arg is None else f"({self.arg:g})"
         scope = "" if self.host is None else f":host={self.host}"
-        return f"{self.kind}@{self.step}*{self.count}{scope}(fired={self.fired})"
+        return f"{self.kind}{param}@{self.step}*{self.count}{scope}(fired={self.fired})"
 
 
 # lazily-resolved process index for host-scoped faults: None until a scoped
@@ -135,6 +154,16 @@ class FaultPlan:
                     f"bad TT_FAULT entry {part!r}: expected "
                     f"<kind>@<step>[*<count>][:host=<p>]")
             kind, _, rest = part.partition("@")
+            kind = kind.strip()
+            arg = None
+            if "(" in kind:
+                kind, _, argtxt = kind.partition("(")
+                argtxt = argtxt.strip()
+                if not argtxt.endswith(")"):
+                    raise ValueError(
+                        f"bad TT_FAULT entry {part!r}: unclosed '(' in kind "
+                        f"argument (expected <kind>(<arg>)@<step>)")
+                arg = float(argtxt[:-1])
             host = None
             if ":" in rest:
                 rest, _, scope = rest.partition(":")
@@ -148,15 +177,17 @@ class FaultPlan:
             if "*" in rest:
                 rest, _, cnt = rest.partition("*")
                 count = int(cnt)
-            faults.append(_Fault(kind.strip(), int(rest), count, host=host))
+            faults.append(_Fault(kind, int(rest), count, host=host, arg=arg))
         return cls(faults)
 
-    def should_fire(self, kind: str, step: int) -> bool:
-        """True (and consumes one firing) if a fault of `kind` is armed for
-        this step. A fault with count K fires at K consecutive opportunities
-        starting at its step index; a host-scoped fault fires only in the
-        process whose index matches (and is never consumed elsewhere, so a
-        spec shared via env across a whole cluster stays deterministic)."""
+    def consume(self, kind: str, step: int) -> Optional[_Fault]:
+        """The armed fault of `kind` due at this step, with one firing
+        consumed — or None. A fault with count K fires at K consecutive
+        opportunities starting at its step index; a host-scoped fault fires
+        only in the process whose index matches (and is never consumed
+        elsewhere, so a spec shared via env across a whole cluster stays
+        deterministic). Returning the fault (not a bool) lets parameterized
+        kinds read their argument (``slow(30)`` -> f.arg == 30.0)."""
         for f in self.faults:
             if f.kind != kind or f.fired >= f.count:
                 continue
@@ -164,8 +195,13 @@ class FaultPlan:
                 continue
             if step >= f.step:
                 f.fired += 1
-                return True
-        return False
+                return f
+        return None
+
+    def should_fire(self, kind: str, step: int) -> bool:
+        """True (and consumes one firing) if a fault of `kind` is armed for
+        this step."""
+        return self.consume(kind, step) is not None
 
     def pending(self) -> list[_Fault]:
         return [f for f in self.faults if f.fired < f.count]
@@ -245,6 +281,32 @@ def maybe_die(step: int) -> None:
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(DIE_EXIT_CODE)
+
+
+def maybe_sleep(step: int) -> None:
+    """slow site: stall THIS process `f.arg` milliseconds at the step
+    boundary — the deterministic stand-in for a straggling host. Emits a
+    ``data_stall`` event first (when the bus is on) so the fleet straggler
+    detector's cause triage names the slowdown instead of guessing; the
+    observability import is deferred so an armed-but-never-fired plan keeps
+    this module free of the dependency."""
+    if _PLAN is None:
+        return
+    f = _PLAN.consume("slow", step)
+    if f is None:
+        return
+    ms = DEFAULT_SLOW_MS if f.arg is None else float(f.arg)
+    try:
+        from ..observability import events as _events
+
+        if _events.enabled():
+            _events.event("data_stall", ms=round(ms, 3), step=int(step),
+                          injected=True)
+    except Exception:
+        pass
+    import time
+
+    time.sleep(ms / 1e3)
 
 
 def maybe_preempt(step: int) -> None:

@@ -40,7 +40,25 @@ import jax
 import jax.numpy as jnp
 
 from ..inference import block_mix, cached_sdpa, split_qkv_rope
+from ..observability import runtime as _obs_runtime
 from ..ops import clang, ltorch
+
+
+def _annotated(cfn, name: str):
+    """Wrap one compiled serving program so each dispatch runs under a
+    host-side profiler annotation (``annotate_call`` — a shared no-op
+    context when the bus is disabled, so the hot path pays one enabled()
+    read). The wrapper keeps ``_cfn`` pointing at the real compiled
+    function, which is the fallback attribute ``last_compile_report``
+    already resolves through."""
+
+    @functools.wraps(cfn)
+    def dispatch(*args, **kwargs):
+        with _obs_runtime.annotate_call(name):
+            return cfn(*args, **kwargs)
+
+    dispatch._cfn = cfn
+    return dispatch
 
 
 def bucket_len(n: int, *, minimum: int, maximum: int) -> int:
@@ -92,10 +110,10 @@ class PagedGPTRunner:
         decode.__name__ = "serve_decode"
         chunk_prefill.__name__ = "serve_chunk_prefill"
         verify.__name__ = "serve_verify"
-        self.prefill_cfn = _jit(prefill)
-        self.decode_cfn = _jit(decode)
-        self.chunk_cfn = _jit(chunk_prefill)
-        self.verify_cfn = _jit(verify)
+        self.prefill_cfn = _annotated(_jit(prefill), "serve_prefill")
+        self.decode_cfn = _annotated(_jit(decode), "serve_decode")
+        self.chunk_cfn = _annotated(_jit(chunk_prefill), "serve_chunk_prefill")
+        self.verify_cfn = _annotated(_jit(verify), "serve_verify")
 
     # block plumbing (qkv split/rope, residual/MoE tail) is shared with the
     # dense engine: inference.split_qkv_rope / inference.block_mix — one

@@ -68,6 +68,7 @@ from ..observability import flight_recorder as _obs_flight
 from ..observability import metrics as _obs_metrics
 from ..observability import runtime as _obs_runtime
 from ..observability import telemetry as _obs_tel
+from ..observability import tracing as _obs_trace
 from ..observability.slo import SLOMonitor, SLOPolicy
 from .kv_pages import PagedKVCache, PrefixCache
 from .runner import PagedGPTRunner
@@ -102,6 +103,10 @@ class _Request:
     future: Future
     t_submit: float
     lane: str = "interactive"
+    # end-to-end trace id (observability/tracing.py), minted at submit()
+    # ONLY when the bus is enabled; None means every downstream trace site
+    # exits on one attribute read (the zero-work-when-disabled contract)
+    trace_id: Optional[str] = None
     t_first: float = 0.0
     t_last: float = 0.0
     tokens: List[int] = field(default_factory=list)
@@ -331,6 +336,8 @@ class ServingEngine:
         req = _Request(rid, prompt, max_new_tokens, float(temperature),
                        int(seed if seed is not None else rid) & 0xFFFFFFFF,
                        eos_id, fut, time.perf_counter(), lane=lane)
+        if _obs.enabled():
+            req.trace_id = _obs_trace.new_trace_id()
         with self._lock:
             if self._stopped:
                 # stop() already flushed the queue; a late submit must fail
@@ -342,6 +349,9 @@ class ServingEngine:
             self._outstanding += 1
         if _obs.enabled():
             _obs_metrics.record_serve("requests")
+            _obs_trace.trace_event(req.trace_id, "submitted",
+                                   request=rid, lane=lane, prompt_len=L,
+                                   max_new=max_new_tokens)
         return fut
 
     def start(self) -> None:
@@ -551,6 +561,11 @@ class ServingEngine:
         covered = 0
         if self.prefix is not None:
             shared, covered = self.prefix.match(prompt_eff)
+            if req.trace_id is not None:
+                _obs_trace.trace_event(req.trace_id, "prefix_lookup",
+                                       request=req.request_id, covered=covered,
+                                       shared_pages=len(shared),
+                                       hit=bool(shared))
         n_shared = len(shared)
         if covered == L_eff and n_shared:
             # full coverage: no prefill at all. The first decode step
@@ -586,6 +601,11 @@ class ServingEngine:
         req.n_shared = n_shared
         req.admit_mode = mode
         req.pages = shared + (self.cache.allocator.alloc(priv) if priv else [])
+        if req.trace_id is not None:
+            _obs_trace.trace_event(
+                req.trace_id, "admitted", request=req.request_id, mode=mode,
+                covered=covered, shared_pages=n_shared, pages=len(req.pages),
+                queued_ms=round((time.perf_counter() - req.t_submit) * 1e3, 3))
         return True
 
     def _final_chunk_end(self, L_eff: int, covered: int) -> int:
@@ -651,6 +671,9 @@ class ServingEngine:
             _obs_metrics.record_serve("resumed", event=True,
                                       request=req.request_id,
                                       n_tokens=len(req.tokens))
+            _obs_trace.trace_event(req.trace_id, "resumed",
+                                   request=req.request_id,
+                                   n_tokens=len(req.tokens))
 
     def _preempt_one(self) -> bool:
         """Spill the most recently admitted batch-lane sequence: free its
@@ -675,6 +698,9 @@ class ServingEngine:
             _obs_metrics.record_serve("preempted", event=True,
                                       request=req.request_id,
                                       n_tokens=len(req.tokens))
+            _obs_trace.trace_event(req.trace_id, "preempted",
+                                   request=req.request_id,
+                                   n_tokens=len(req.tokens))
         return True
 
     def _maybe_preempt_for_slo(self) -> None:
@@ -707,6 +733,9 @@ class ServingEngine:
             _obs_metrics.record_serve("failed", event=True,
                                       request=req.request_id,
                                       error=type(exc).__name__)
+            _obs_trace.trace_event(req.trace_id, "failed",
+                                   request=req.request_id,
+                                   error=type(exc).__name__)
 
     def _prefill(self, req: _Request, slot: int) -> None:
         obs_on = _obs.enabled()
@@ -759,6 +788,10 @@ class ServingEngine:
             _obs_tel.observe("serve.prefill_ms", (t_done - t0) * 1e3)
             _obs_tel.set_gauge("serve.pool_utilization", util)
             _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
+            _obs_trace.trace_event(req.trace_id, "prefill",
+                                   request=req.request_id,
+                                   dur_ms=(t_done - t0) * 1e3, bucket=bucket,
+                                   prompt_len=L)
         if resumed:
             # the spilled stream already owns its next token; no sampling
             # (and t_first keeps the FIRST life's stamp — TTFT is end-to-end)
@@ -842,8 +875,11 @@ class ServingEngine:
         req.chunk_pos = min(start + cb, L_eff)
         if obs_on:
             _obs_metrics.record_serve("prefill_tokens", delta=n_real)
-            _obs_tel.observe("serve.prefill_ms",
-                             (time.perf_counter() - t0) * 1e3)
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            _obs_tel.observe("serve.prefill_ms", dur_ms)
+            _obs_trace.trace_event(req.trace_id, "prefill_chunk",
+                                   request=req.request_id, dur_ms=dur_ms,
+                                   start=start, tokens=n_real)
         return cb, logits
 
     def _finish_chunked(self, req: _Request, slot: int, logits) -> None:
@@ -968,6 +1004,12 @@ class ServingEngine:
             # online decode-iteration latency percentiles (unsampled, like
             # the flight recorder — TT_OBS_SAMPLE only thins the spans)
             _obs_tel.observe("serve.decode_ms", (t_now - t0) * 1e3)
+            # ONE shared trace event per step carrying every participant
+            # (volume scales with steps, not steps × batch width)
+            _obs_trace.trace_step(
+                [self._slots[i].trace_id for i in active], "decode",
+                dur_ms=(t_now - t0) * 1e3, step=self.decode_steps,
+                active=len(active))
         for i in active:
             self._commit(i, self._slots[i], int(nxt[i]), t_now)
 
@@ -1025,6 +1067,10 @@ class ServingEngine:
             return
         t_now = time.perf_counter()
         self.decode_steps += 1
+        # participant ids captured BEFORE commits (a finishing commit clears
+        # its slot); only read when tracing is on
+        trace_ids = ([self._slots[i].trace_id for i in active]
+                     if obs_on else [])
         committed_total = 0
         accepted_total = 0
         for i in active:
@@ -1052,6 +1098,11 @@ class ServingEngine:
                                     active=len(active), spec_k=k,
                                     committed=committed_total)
             _obs_tel.observe("serve.decode_ms", (t_now - t0) * 1e3)
+            _obs_trace.trace_step(trace_ids, "spec_verify",
+                                  dur_ms=(t_now - t0) * 1e3,
+                                  step=self.decode_steps, spec_k=k,
+                                  accepted=accepted_total,
+                                  committed=committed_total)
 
     def _finished(self, req: _Request, tok: int) -> bool:
         if req.future.cancelled():
@@ -1117,6 +1168,10 @@ class ServingEngine:
                 event=True, request=req.request_id, n_new=n_new,
                 ttft_ms=round(ttft * 1e3, 3), tbot_ms=round(tbot * 1e3, 3),
                 finish=reason, lane=req.lane, pool_utilization=util)
+            _obs_trace.trace_event(req.trace_id, "retired",
+                                   request=req.request_id, finish=reason,
+                                   n_new=n_new, ttft_ms=round(ttft * 1e3, 3),
+                                   lane=req.lane)
         result = RequestResult(
             request_id=req.request_id,
             tokens=np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)]),

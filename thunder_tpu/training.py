@@ -577,6 +577,9 @@ class TrainStep:
         # module-global read — the same zero-work contract as the bus
         step_idx = self._step_count
         if _rb_faults.active():
+            # `slow` stalls the host at the step boundary (straggler
+            # injection for the fleet detector) before any device work
+            _rb_faults.maybe_sleep(step_idx)
             args, kwargs = _rb_faults.maybe_poison(args, kwargs, step_idx)
         tparam_arrays, frozen_arrays, t_pairs = self._split_arrays()
         if self.opt_state is None:

@@ -4,17 +4,24 @@ Reads one or more event-bus exports (TT_OBS_FILE=..., observability.dump(),
 per-process shards, or the bench artifact OBS_TIMELINE.jsonl) and renders
 the views an operator actually wants: the compile-phase span tree with
 durations, cache traffic and recompile reasons, step-latency statistics,
-and — via the ``perf`` subcommand — the device-time/FLOPs breakdown
-recorded by ``observability.profile_steps``.
+a per-host fleet breakdown (step latency + straggler flags per shard),
+the ``perf`` subcommand's device-time/FLOPs view, and the ``trace``
+subcommand's end-to-end request timeline (submitted -> ... -> retired,
+optionally exported as Chrome trace-event JSON for chrome://tracing).
 
 Usage:
     python tools/obs_summary.py TIMELINE.jsonl [more.jsonl ...] [--top N]
     python tools/obs_summary.py perf TIMELINE.jsonl [more.jsonl ...]
+    python tools/obs_summary.py trace REQUEST_ID TIMELINE.jsonl [more.jsonl ...]
+                                [--chrome out.json]
 
 Multiple shards are merged: records from shard i get the composite process
 key ``s<i>:<pid>`` (two hosts can share a pid) and the merged stream is
 sorted by monotonic time within each process. Exits non-zero with a clear
-message when the merged timeline holds no parseable records.
+message when the merged timeline holds no parseable records. This tool is
+deliberately stdlib-only (no thunder_tpu/jax import) so it runs anywhere a
+shard lands — the trace/fleet views re-derive their structure from the raw
+JSONL schema documented in docs/observability.md.
 """
 from __future__ import annotations
 
@@ -362,6 +369,142 @@ def slo_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     return lines
 
 
+def fleet_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """Per-host fleet breakdown: step latency per process shard, straggler
+    onset/recovery events (observability/fleet.py), and the fleet.* /
+    trace.* counter families. Only rendered when the timeline carries
+    multi-host signal (several pids, straggler events, or fleet counters)."""
+    # trace.* here means request tracing (trace.requests / trace.spans) —
+    # the specialization cache is ALSO named "trace", and its hit/miss/evict
+    # outcomes already render in the cache table
+    fleet_counters = {k: v for k, v in counters.items()
+                      if (k.startswith("fleet.") or k.startswith("trace."))
+                      and k.partition(".")[2] not in ("hit", "miss", "evict")}
+    strag_evs = [r for r in recs if r.get("kind") == "event"
+                 and r.get("name") in ("straggler", "straggler.recovered")]
+    by_pid: dict = {}
+    spikes_by_pid: dict = {}
+    for r in recs:
+        if r.get("kind") == "span" and r.get("name") in _STEP_SPANS:
+            by_pid.setdefault(r.get("pid", 0), []).append(r["dur_ms"])
+        elif r.get("kind") == "event" and r.get("name") == "step_spike":
+            pid = r.get("pid", 0)
+            spikes_by_pid[pid] = spikes_by_pid.get(pid, 0) + 1
+    multi_host = len(by_pid) > 1
+    if not fleet_counters and not strag_evs and not multi_host:
+        return []
+    lines = []
+    for k, v in sorted(fleet_counters.items()):
+        lines.append(f"  {k:<28} {v}")
+    if multi_host:
+        lines.append(f"  {'host':<12} {'steps':>6} {'p50':>9} {'p95':>9} "
+                     f"{'max':>9} {'spikes':>7}")
+        for pid, durs in sorted(by_pid.items(), key=lambda kv: str(kv[0])):
+            durs.sort()
+            n = len(durs)
+            lines.append(
+                f"  {pid!s:<12} {n:>6} {durs[n // 2]:>7.2f}ms "
+                f"{durs[min(n - 1, int(n * 0.95))]:>7.2f}ms {durs[-1]:>7.2f}ms "
+                f"{spikes_by_pid.get(pid, 0):>7}")
+    for r in strag_evs[-8:]:
+        a = r.get("attrs", {})
+        kind = "STRAGGLER" if r["name"] == "straggler" else "recovered"
+        ratio = f" ({a['ratio']}x fleet)" if a.get("ratio") is not None else ""
+        lines.append(f"    @{r['ts_ms']:.0f}ms  {kind:<10} host={a.get('host', '?')}  "
+                     f"median={a.get('median_ms', '?')}ms"
+                     f"{ratio}  cause={a.get('cause', '-')}")
+    return lines
+
+
+# canonical request-lifecycle phase order (mirrors observability/tracing.py
+# PHASES) — used to stabilize sorting when several trace events share one
+# timestamp (e.g. admitted + prefill landing in the same millisecond)
+_TRACE_PHASES = ("submitted", "prefix_lookup", "admitted", "prefill",
+                 "prefill_chunk", "decode", "spec_verify", "preempted",
+                 "resumed", "retired", "failed")
+
+
+def trace_entries(recs: list[dict], request_id: str) -> tuple[str, list[dict]]:
+    """Resolve `request_id` to its trace id, then collect that request's
+    trace events — both its own and the shared per-step events (decode /
+    spec_verify batches carry ``trace_ids=[...]`` for every participant).
+    Returns (trace_id, entries sorted by time then phase order)."""
+    trace_id = None
+    for r in recs:
+        if r.get("kind") == "event" and r.get("name") == "trace":
+            a = r.get("attrs") or {}
+            if str(a.get("request")) == str(request_id) and a.get("trace_id"):
+                trace_id = a["trace_id"]
+                break
+    if trace_id is None:
+        return "", []
+    entries = []
+    for r in recs:
+        if r.get("kind") != "event" or r.get("name") != "trace":
+            continue
+        a = r.get("attrs") or {}
+        if a.get("trace_id") == trace_id or trace_id in (a.get("trace_ids") or ()):
+            entries.append(r)
+
+    def order(r):
+        phase = (r.get("attrs") or {}).get("phase", "")
+        rank = _TRACE_PHASES.index(phase) if phase in _TRACE_PHASES else len(_TRACE_PHASES)
+        return (r.get("ts_ms", 0.0), rank)
+
+    entries.sort(key=order)
+    return trace_id, entries
+
+
+def render_trace(recs: list[dict], request_id: str) -> str:
+    trace_id, entries = trace_entries(recs, request_id)
+    if not entries:
+        return (f"(no trace events for request {request_id!r} — was the "
+                f"request submitted with observability enabled?)")
+    t0 = entries[0].get("ts_ms", 0.0)
+    out = [f"== trace {trace_id} (request {request_id}) =="]
+    for r in entries:
+        a = dict(r.get("attrs") or {})
+        phase = a.pop("phase", "?")
+        for k in ("trace_id", "trace_ids", "request"):
+            a.pop(k, None)
+        dur = a.pop("dur_ms", None)
+        dur_s = f" {dur:>8.2f}ms" if isinstance(dur, (int, float)) else " " * 11
+        detail = " ".join(f"{k}={v}" for k, v in a.items())
+        out.append(f"  +{r.get('ts_ms', 0.0) - t0:>10.1f}ms  {phase:<14}"
+                   f"{dur_s}  {detail}".rstrip())
+    span_ms = entries[-1].get("ts_ms", 0.0) - t0
+    phases = [(r.get("attrs") or {}).get("phase") for r in entries]
+    out.append(f"  {len(entries)} event(s), {phases[0]} -> {phases[-1]}, "
+               f"{span_ms:.1f}ms end to end")
+    return "\n".join(out)
+
+
+def chrome_trace_json(recs: list[dict], request_id: str) -> dict:
+    """Chrome trace-event JSON (chrome://tracing / Perfetto) for one
+    request: duration phases become complete ("X") events positioned at
+    ``ts - dur`` (the emitter stamps events at phase END); instantaneous
+    phases become thread-scoped instants ("i")."""
+    trace_id, entries = trace_entries(recs, request_id)
+    pids = {}
+    evs = []
+    for r in entries:
+        a = dict(r.get("attrs") or {})
+        phase = a.pop("phase", "?")
+        for k in ("trace_id", "trace_ids", "request"):
+            a.pop(k, None)
+        dur = a.pop("dur_ms", None)
+        pid = pids.setdefault(str(r.get("pid", 0)), len(pids))
+        base = {"name": phase, "cat": "serving", "pid": pid,
+                "tid": trace_id or str(request_id), "args": a}
+        ts_us = r.get("ts_ms", 0.0) * 1e3
+        if isinstance(dur, (int, float)) and dur > 0:
+            evs.append({**base, "ph": "X", "ts": ts_us - dur * 1e3,
+                        "dur": dur * 1e3})
+        else:
+            evs.append({**base, "ph": "i", "ts": ts_us, "s": "t"})
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
 def device_profiles(recs: list[dict]) -> list[dict]:
     return [r["attrs"]["profile"] for r in recs
             if r.get("kind") == "event" and r.get("name") == "device_profile"
@@ -449,11 +592,15 @@ def render(recs: list[dict], top: int = 0) -> str:
     ckpt = checkpoint_lines(recs, counters)
     if ckpt:
         out += ["", "== checkpoint / robustness ==", *ckpt]
+    fleet = fleet_lines(recs, counters)
+    if fleet:
+        out += ["", "== fleet ==", *fleet]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.") and not k.startswith("serve.")
              and not k.startswith("slo.breach.") and not k.startswith("artifact.")
              and not k.startswith("compile.") and not k.startswith("checkpoint.")
              and not k.startswith("desync.") and not k.startswith("guard.dist_")
+             and not k.startswith("fleet.") and not k.startswith("trace.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]
@@ -463,10 +610,16 @@ def render(recs: list[dict], top: int = 0) -> str:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    perf = bool(argv) and argv[0] == "perf"
-    if perf:
+    sub = argv[0] if argv and argv[0] in ("perf", "trace") else None
+    if sub:
         argv = argv[1:]
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    if sub == "trace":
+        ap.add_argument("request_id",
+                        help="request id passed to ServingEngine.submit()")
+        ap.add_argument("--chrome", metavar="OUT.json", default=None,
+                        help="also write Chrome trace-event JSON "
+                             "(load in chrome://tracing or Perfetto)")
     ap.add_argument("timeline", nargs="+",
                     help="JSONL shard(s) written by TT_OBS_FILE / observability.dump(); "
                          "several shards are merged by process")
@@ -481,7 +634,17 @@ def main(argv=None) -> int:
         print(f"error: no parseable records in {', '.join(ns.timeline)} "
               f"(empty or entirely malformed timeline)", file=sys.stderr)
         return 2
-    print(render_perf(recs) if perf else render(recs, top=ns.top))
+    if sub == "trace":
+        text = render_trace(recs, ns.request_id)
+        print(text)
+        if text.startswith("(no trace events"):
+            return 1
+        if ns.chrome:
+            with open(ns.chrome, "w") as f:
+                json.dump(chrome_trace_json(recs, ns.request_id), f)
+            print(f"# wrote {ns.chrome}")
+        return 0
+    print(render_perf(recs) if sub == "perf" else render(recs, top=ns.top))
     return 0
 
 

@@ -13,7 +13,8 @@ Direction vocabulary (keys not listed are informational and never gated):
                      goodput, requests_per_s, requests_per_s_slo_met, mfu,
                      mfu_measured, tflops_per_sec, vs_baseline
   lower is better    ttft_ms_*, tbot_ms_*, compile_time_s,
-                     compile_time_warm_s, host_overhead_us, ms_per_token,
+                     compile_time_warm_s, host_overhead_us, obs_overhead_us
+                     (the disabled-tracing hot-path cost), ms_per_token,
                      mem_peak_estimated (the live-range peak-HBM estimate —
                      estimator regressions gate like perf regressions),
                      recompiles_steady_state (zero-tolerance: any increase
@@ -56,6 +57,13 @@ HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
 LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
 LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
                 "ms_per_token", "mem_peak_estimated",
+                # disabled-path cost of request tracing (min-of-repeats
+                # tracing.disabled_overhead_us(): enabled() check + one
+                # trace_step + one trace_event per iteration) — the
+                # zero-work-when-disabled contract as a GATED number, so an
+                # unconditional allocation sneaking onto the decode hot path
+                # fails CI instead of taxing every fleet
+                "obs_overhead_us",
                 # the cold→warm compile ladder (BENCH_COMPILE.json): the
                 # ratio gates robustly across machines whose absolute cold
                 # compile times differ
