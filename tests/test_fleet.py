@@ -133,6 +133,8 @@ class TestHistogramMerge:
 
 class TestResetScope:
     def test_reset_clears_flight_recorder_and_slo_windows(self):
+        from thunder_tpu.observability import memory_watch
+
         events.enable()
         for i in range(16):
             flight_recorder.record_step(3.0 + 0.01 * i)
@@ -141,11 +143,17 @@ class TestResetScope:
         for _ in range(8):
             mon.observe_request(ttft_ms=50.0, tbot_ms=None, met=False)
         telemetry.observe("x.ms", 5.0)
+        memory_watch.note_estimate({"peak_bytes": 123})
+        memory_watch.on_step(7)
         assert flight_recorder.stats() is not None
         assert mon.breaches >= 1
+        assert memory_watch.watermarks() and memory_watch.peak_seen() > 0
         events.reset()
         assert flight_recorder.stats() is None
         assert telemetry.histogram("x.ms") is None
+        # memory_watch watermark ring + peak + noted estimate are in scope
+        assert memory_watch.watermarks() == []
+        assert memory_watch.peak_seen() == 0.0
         st = mon.status()
         assert mon.breaches == 0
         assert not any(t.get("breached") for t in st.get("targets", {}).values())
@@ -332,7 +340,25 @@ class TestIncidents:
         ranked = [c for c, _ in inc["likely_causes"]]
         assert ranked[0] == "recompile"
         assert inc["evidence"] == {"spikes": 1, "recompiles": 1,
-                                   "stragglers": 1, "pool_pressure": 1}
+                                   "stragglers": 1, "pool_pressure": 1,
+                                   "ooms": 0, "mem_pressure": 0}
+
+    def test_oom_evidence_outranks_every_other_cause(self):
+        events.enable()
+        events.event("recompile", reason="shape-change")
+        events.event("oom", step=4, source="train", bundle="/tmp/b.json")
+        events.event("mem_pressure", step=3, utilization=0.95)
+        events.event("slo.breach", reason="p99-step", source="training",
+                     value=90.0, target=50.0)
+        incs = obs.incidents()
+        assert len(incs) == 1
+        causes = dict(incs[0]["likely_causes"])
+        assert causes["oom"] == 5.0
+        assert causes["mem-pressure"] == 1.5
+        ranked = [c for c, _ in incs[0]["likely_causes"]]
+        assert ranked[0] == "oom"
+        assert incs[0]["evidence"]["ooms"] == 1
+        assert incs[0]["evidence"]["mem_pressure"] == 1
 
     def test_evidence_window_excludes_distant_events(self):
         events.enable()

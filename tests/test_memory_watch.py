@@ -1,0 +1,301 @@
+"""Live memory observability (ISSUE 18): watermark sampling, pressure and
+estimate-drift events, the live-array census, OOM forensics end to end
+through the TT_FAULT harness, and the obs_summary memory section.
+
+Deterministic device samples come from monkeypatching
+``memory_watch.sample`` — the CPU backend has no ``memory_stats()``, so the
+pressure/drift logic (which needs ``bytes_limit`` and ``source: device``)
+can only be pinned with synthetic samples.
+"""
+import importlib.util
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu import observability, optim
+from thunder_tpu.observability import flight_recorder as fr
+from thunder_tpu.observability import memory_watch as mw
+from thunder_tpu.observability import telemetry
+from thunder_tpu.robustness import faults
+from thunder_tpu.training import TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_summary():
+    spec = importlib.util.spec_from_file_location(
+        "obs_summary", os.path.join(REPO, "tools", "obs_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_bus():
+    faults.clear()
+    observability.reset()
+    yield
+    observability.disable()
+    observability.reset()
+    faults.clear()
+    mw.register_pool_state(None)
+
+
+@pytest.fixture
+def obs():
+    observability.enable()
+    yield
+    observability.disable()
+
+
+def _events(name):
+    return [r for r in observability.records()
+            if r.get("kind") == "event" and r.get("name") == name]
+
+
+def _dev_sample(in_use, peak, limit=None):
+    out = {"bytes_in_use": in_use, "peak_bytes_in_use": peak,
+           "source": "device"}
+    if limit:
+        out["bytes_limit"] = limit
+    return out
+
+
+# ---------------------------------------------------------------------------
+# zero work when disabled
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledContract:
+    def test_on_step_disabled_never_samples(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(mw, "sample", lambda: calls.append(1) or None)
+        assert not observability.enabled()
+        for i in range(8):
+            mw.on_step(i)
+        assert calls == []
+        assert mw.watermarks() == []
+        assert mw.peak_seen() == 0.0
+        assert telemetry.gauge("mem.bytes_in_use") is None
+        assert observability.counters() == {}
+
+    def test_oom_bundle_written_even_with_bus_disabled(self, tmp_path,
+                                                       monkeypatch):
+        # forensics are not opt-in: the file lands, only the event is gated
+        monkeypatch.setenv("TT_OOM_FILE", str(tmp_path / "oom.json"))
+        path = mw.oom_post_mortem(RuntimeError("RESOURCE_EXHAUSTED: boom"))
+        assert path and os.path.exists(path)
+        assert _events("oom") == []
+        assert "mem.oom" not in observability.counters()
+
+
+# ---------------------------------------------------------------------------
+# sampling, pressure, drift
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_watermark_ring_and_gauges(self, obs, monkeypatch):
+        samples = iter([_dev_sample(100, 150), _dev_sample(120, 180),
+                        _dev_sample(90, 180)])
+        monkeypatch.setattr(mw, "sample", lambda: next(samples))
+        for i in range(3):
+            mw.on_step(i, source="train")
+        marks = mw.watermarks()
+        assert [m["step"] for m in marks] == [0, 1, 2]
+        assert marks[1]["bytes_in_use"] == 120
+        assert mw.peak_seen() == 180.0
+        assert telemetry.gauge("mem.bytes_in_use") == 90.0
+        assert telemetry.gauge("mem.peak_bytes_in_use") == 180.0
+        # mem_sample only fires on a NEW high-water mark: steps 0 and 1
+        highs = _events("mem_sample")
+        assert [e["attrs"]["step"] for e in highs] == [0, 1]
+        assert highs[0]["attrs"]["mem_source"] == "device"
+
+    def test_pressure_event_transition_deduped_with_hysteresis(
+            self, obs, monkeypatch):
+        seq = iter([_dev_sample(95, 95, limit=100),   # cross -> event
+                    _dev_sample(96, 96, limit=100),   # still high -> no event
+                    _dev_sample(50, 96, limit=100),   # below clear -> re-arm
+                    _dev_sample(93, 96, limit=100)])  # cross again -> event
+        monkeypatch.setattr(mw, "sample", lambda: next(seq))
+        for i in range(4):
+            mw.on_step(i)
+        assert observability.counters().get("mem.pressure") == 2
+        assert [e["attrs"]["step"] for e in _events("mem_pressure")] == [0, 3]
+        assert telemetry.gauge("mem.utilization") == pytest.approx(0.93)
+
+    def test_estimate_drift_fires_once_per_noted_estimate(
+            self, obs, monkeypatch):
+        monkeypatch.setattr(mw, "sample", lambda: _dev_sample(300, 300))
+        mw.note_estimate({"peak_bytes": 100})
+        mw.on_step(0)
+        mw.on_step(1)  # deduped: same noted estimate
+        drifts = _events("mem.estimate_drift")
+        assert len(drifts) == 1
+        assert drifts[0]["attrs"]["ratio"] == pytest.approx(3.0)
+        mw.note_estimate({"peak_bytes": 100})  # re-arm
+        mw.on_step(2)
+        assert len(_events("mem.estimate_drift")) == 2
+
+    def test_host_rss_samples_never_drift_check(self, obs, monkeypatch):
+        # host RSS covers the whole python process; comparing it to a
+        # device-bytes budget would alert on every CPU run
+        monkeypatch.setattr(mw, "sample", lambda: {
+            "bytes_in_use": 10**9, "peak_bytes_in_use": 10**9,
+            "source": "host_rss"})
+        mw.note_estimate({"peak_bytes": 100})
+        mw.on_step(0)
+        assert _events("mem.estimate_drift") == []
+
+    def test_cpu_backend_real_sample_falls_back_to_host_rss(self, obs):
+        s = mw.sample()
+        assert s is not None
+        assert s["source"] in ("device", "host_rss")
+        assert s["bytes_in_use"] > 0
+        mw.on_step(0)
+        assert mw.watermarks()
+
+    def test_reconcile_emits_drift_event_beyond_2x(self, obs):
+        assert mw.reconcile(500, 100, context="bench") == pytest.approx(5.0)
+        assert mw.reconcile(100, 150) == pytest.approx(2.0 / 3.0)  # in band
+        drifts = _events("mem.estimate_drift")
+        assert len(drifts) == 1
+        assert drifts[0]["attrs"]["context"] == "bench"
+        assert mw.reconcile(None, 100) is None
+
+    def test_census_groups_by_shape_dtype(self, obs):
+        keep = [jnp.ones((32, 32), jnp.float32) for _ in range(3)]
+        groups = mw.census(top_n=32)
+        match = [g for g in groups
+                 if g["shape"] == [32, 32] and g["dtype"] == "float32"]
+        assert match and match[0]["count"] >= 3
+        assert match[0]["bytes"] >= 3 * 32 * 32 * 4
+        del keep
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: fault -> dispatch -> bundle + event
+# ---------------------------------------------------------------------------
+
+
+class _TinyNet(tt.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc = tt.nn.Linear(8, 4, seed=3)
+
+    def forward(self, x, y):
+        from thunder_tpu.ops import ltorch
+        return ltorch.mse_loss(self.fc(x), y)
+
+
+def _make_step():
+    step = TrainStep(tt.jit(_TinyNet()), optim.SGD(lr=0.01))
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+    y = jnp.zeros((4, 4), jnp.float32)
+    return step, x, y
+
+
+class TestOOMForensics:
+    def test_is_oom_shapes(self):
+        assert mw.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert mw.is_oom(MemoryError("Out of memory allocating 2GB"))
+        assert not mw.is_oom(ValueError("shapes do not match"))
+        assert mw.maybe_post_mortem(ValueError("nope")) is None
+
+    def test_injected_fault_raises_xla_runtime_error_shape(self):
+        faults.configure("oom@2")
+        faults.maybe_oom(1)  # not yet
+        with pytest.raises(Exception) as ei:
+            faults.maybe_oom(2)
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        assert mw.is_oom(ei.value)
+
+    def test_train_step_oom_dumps_forensic_bundle(self, obs, tmp_path,
+                                                  monkeypatch):
+        bundle_path = tmp_path / "oom.json"
+        monkeypatch.setenv("TT_OOM_FILE", str(bundle_path))
+        mw.register_pool_state(lambda: {"pages_in_use": 7, "n_pages": 32})
+        mw.note_estimate({"peak_bytes": 12345, "peak_gb": 0.0})
+        faults.configure("oom@1")
+        step, x, y = _make_step()
+        step(x, y)  # step 0 runs clean (and samples a watermark)
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            step(x, y)
+
+        bundle = json.loads(bundle_path.read_text())
+        assert bundle["kind"] == "oom_post_mortem"
+        assert bundle["source"] == "train"
+        assert bundle["step"] == 1
+        assert "RESOURCE_EXHAUSTED" in bundle["error"]
+        # the four forensic sections the runbook relies on
+        assert bundle["watermarks"], "watermark ring missing from bundle"
+        assert bundle["live_array_census"], "census missing from bundle"
+        assert bundle["page_pool"] == {"pages_in_use": 7, "n_pages": 32}
+        assert bundle["budget_estimate"]["peak_bytes"] == 12345
+        assert bundle["memory"]["bytes_in_use"] > 0
+
+        (oom,) = _events("oom")
+        assert oom["attrs"]["step"] == 1
+        assert oom["attrs"]["source"] == "train"
+        assert oom["attrs"]["bundle"] == str(bundle_path)
+        assert oom["attrs"]["estimated_peak_bytes"] == 12345
+        assert observability.counters().get("mem.oom") == 1
+
+    def test_oom_ranks_as_flight_recorder_cause(self, obs, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("TT_OOM_FILE", str(tmp_path / "oom.json"))
+        for _ in range(12):
+            fr.record_step(3.0)
+        mw.oom_post_mortem(RuntimeError("RESOURCE_EXHAUSTED: boom"), step=12)
+        spike = fr.record_step(30.0)  # spike with a recent oom on the bus
+        assert spike is not None, "spike detection did not fire"
+        assert spike["cause"] == "oom"
+        assert spike["bundle"] == str(tmp_path / "oom.json")
+        # counted twice by design: the spike's triaged cause + the raw event
+        assert fr.recorder().cause_counts().get("oom", 0) >= 1
+
+    def test_events_reset_clears_watermark_state(self, obs, monkeypatch):
+        monkeypatch.setattr(mw, "sample", lambda: _dev_sample(10, 20))
+        mw.note_estimate({"peak_bytes": 1})
+        mw.on_step(0)
+        assert mw.watermarks() and mw.peak_seen() == 20.0
+        observability.reset()
+        assert mw.watermarks() == []
+        assert mw.peak_seen() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# obs_summary memory section
+# ---------------------------------------------------------------------------
+
+
+class TestMemSummary:
+    def test_summary_renders_memory_section_from_shard(self, obs, tmp_path,
+                                                       monkeypatch):
+        bundle_path = tmp_path / "oom.json"
+        monkeypatch.setenv("TT_OOM_FILE", str(bundle_path))
+        seq = [_dev_sample(100, 150, limit=160),
+               _dev_sample(155, 158, limit=160)]
+        # pop until the last sample sticks: oom_post_mortem samples again
+        monkeypatch.setattr(
+            mw, "sample", lambda: seq.pop(0) if len(seq) > 1 else seq[0])
+        mw.on_step(0)
+        mw.on_step(1)  # pressure crossing
+        mw.reconcile(500, 100)
+        mw.oom_post_mortem(RuntimeError("RESOURCE_EXHAUSTED: boom"), step=1)
+
+        shard = str(tmp_path / "mem.jsonl")
+        observability.dump(shard)
+        mod = _load_obs_summary()
+        recs = mod.load_many([shard])
+        out = mod.render(recs)
+        assert "== memory ==" in out
+        assert "oom" in out
+        assert str(bundle_path) in out
+        assert "drift" in out

@@ -144,17 +144,19 @@ def enabled() -> bool:
 def reset() -> None:
     """Clear recorded state (tests; keeps enabled/export settings). Also
     clears the live-telemetry registry (histograms/gauges), the flight
-    recorder's ring + spike state, and every live SLO monitor's sliding
-    windows, so one reset between benchmark phases leaves no stale spike/
-    breach state to pollute the next phase's incident view."""
+    recorder's ring + spike state, the memory watcher's watermark ring, and
+    every live SLO monitor's sliding windows, so one reset between
+    benchmark phases leaves no stale spike/breach state to pollute the next
+    phase's incident view."""
     with _BUS.lock:
         _BUS.records.clear()
         _BUS.counters.clear()
     # deferred: these modules import this one
-    from . import flight_recorder, slo, telemetry
+    from . import flight_recorder, memory_watch, slo, telemetry
 
     telemetry.reset()
     flight_recorder.reset()
+    memory_watch.reset()
     slo.reset_windows()
 
 

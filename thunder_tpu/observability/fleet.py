@@ -291,10 +291,11 @@ def render_prometheus_fleet() -> str:
 
 # -- incident correlation ----------------------------------------------------
 
-# evidence weights for cause ranking: a contemporaneous recompile almost
-# always IS the story; pool pressure is a symptom more than a cause
-_EVIDENCE_WEIGHT = {"recompile": 4.0, "straggler": 3.0, "spike": 2.0,
-                    "pool-pressure": 1.0}
+# evidence weights for cause ranking: an OOM ends the debate outright; a
+# contemporaneous recompile almost always IS the story; memory/pool
+# pressure are symptoms more than causes
+_EVIDENCE_WEIGHT = {"oom": 5.0, "recompile": 4.0, "straggler": 3.0,
+                    "spike": 2.0, "mem-pressure": 1.5, "pool-pressure": 1.0}
 _POOL_PRESSURE = 0.9   # pool_utilization at/above this counts as pressure
 
 
@@ -312,6 +313,7 @@ def incidents(*, window_ms: float = 2000.0,
     recs = _events.records() if records is None else records
     evs = [r for r in recs if r.get("kind") == "event"]
     breaches, spikes, recompiles, stragglers, pressure = [], [], [], [], []
+    ooms, mem_pressure = [], []
     for r in evs:
         name, attrs = r.get("name"), r.get("attrs") or {}
         if name == "slo.breach":
@@ -322,6 +324,10 @@ def incidents(*, window_ms: float = 2000.0,
             recompiles.append(r)
         elif name == "straggler":
             stragglers.append(r)
+        elif name == "oom":
+            ooms.append(r)
+        elif name in ("mem_pressure", "mem.estimate_drift"):
+            mem_pressure.append(r)
         elif (attrs.get("pool_utilization") or 0) >= _POOL_PRESSURE:
             pressure.append(r)
     out = []
@@ -332,7 +338,8 @@ def incidents(*, window_ms: float = 2000.0,
             return [r for r in rs if abs(r.get("ts_ms", 0.0) - t) <= window_ms]
 
         ev = {"spikes": near(spikes), "recompiles": near(recompiles),
-              "stragglers": near(stragglers), "pool_pressure": near(pressure)}
+              "stragglers": near(stragglers), "pool_pressure": near(pressure),
+              "ooms": near(ooms), "mem_pressure": near(mem_pressure)}
         scores: dict[str, float] = {}
 
         def add(cause, weight):
@@ -349,6 +356,10 @@ def incidents(*, window_ms: float = 2000.0,
             a = r.get("attrs") or {}
             add(f"spike-{a.get('cause', 'unknown')}",
                 _EVIDENCE_WEIGHT["spike"])
+        for r in ev["ooms"]:
+            add("oom", _EVIDENCE_WEIGHT["oom"])
+        for r in ev["mem_pressure"]:
+            add("mem-pressure", _EVIDENCE_WEIGHT["mem-pressure"])
         for r in ev["pool_pressure"]:
             add("pool-pressure", _EVIDENCE_WEIGHT["pool-pressure"])
         a = b.get("attrs") or {}

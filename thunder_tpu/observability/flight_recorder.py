@@ -88,13 +88,16 @@ class FlightRecorder:
 
     def _likely_cause(self) -> tuple[str, dict]:
         """Scan the bus's most recent records for the event that explains a
-        slow step. Priority: a recompile (reason-coded, the usual killer) →
+        slow step. Priority: an OOM (nothing else matters once the
+        allocator gave up) → a recompile (reason-coded, the usual killer) →
         a guard intervention (retry/rollback stretch the step wall time) →
         an overlapping checkpoint save (host snapshot + writer IO contend
-        with dispatch) → a data stall (prefetch underrun) → an outsized
-        host_overhead → unknown. Within one category the most recent event
-        wins; across categories the priority order wins even when a
-        routine lower-priority event is more recent."""
+        with dispatch) → a data stall (prefetch underrun) → a memory-
+        pressure transition (allocator thrash near the limit slows steps
+        before it kills them) → an outsized host_overhead → unknown.
+        Within one category the most recent event wins; across categories
+        the priority order wins even when a routine lower-priority event
+        is more recent."""
         # the public accessor copies under the bus lock; iterating the live
         # deque would race concurrent emitters (safe only by GIL accident)
         recent = _obs.records()[-_CAUSE_WINDOW_RECORDS:]
@@ -106,7 +109,10 @@ class FlightRecorder:
                 continue
             name = r.get("name")
             attrs = r.get("attrs") or {}
-            if name == "recompile" and "recompile" not in found:
+            if name == "oom" and "oom" not in found:
+                found["oom"] = ("oom", {"oom_step": attrs.get("step"),
+                                        "bundle": attrs.get("bundle")})
+            elif name == "recompile" and "recompile" not in found:
                 found["recompile"] = ("recompile", {"reason": attrs.get("reason")})
             elif name == "guard" and "guard" not in found:
                 found["guard"] = ("guard-intervention", {"reason": attrs.get("reason")})
@@ -116,7 +122,10 @@ class FlightRecorder:
                                   "save_ms": attrs.get("ms")})
             elif name in ("data_stall", "prefetch_stall") and "stall" not in found:
                 found["stall"] = ("data-stall", {"stall_ms": attrs.get("ms")})
-        for key in ("recompile", "guard", "ckpt", "stall"):
+            elif name == "mem_pressure" and "mem" not in found:
+                found["mem"] = ("mem-pressure",
+                                {"utilization": attrs.get("utilization")})
+        for key in ("oom", "recompile", "guard", "ckpt", "stall", "mem"):
             if key in found:
                 return found[key]
         if len(host_us) >= 2 and host_us[-1] > 5.0 * (sorted(host_us)[len(host_us) // 2] or 1.0):
@@ -162,6 +171,10 @@ class FlightRecorder:
             name = r.get("name")
             if name == "recompile":
                 bump("recompile")
+            elif name == "oom":
+                bump("oom")
+            elif name == "mem_pressure":
+                bump("mem-pressure")
             elif name in ("data_stall", "prefetch_stall"):
                 bump("data-stall")
             elif name == "checkpoint_save":

@@ -120,6 +120,73 @@ def _reduction_flops(bsym) -> float:
     return float(_in_numel(bsym))
 
 
+# -- collective cost (ring model) -------------------------------------------
+
+# mesh-axis sizes for collectives whose bsym carries no ``world_size``
+# kwarg (dist.all_reduce, dist.synchronize take only (x, axis)): the
+# parallel frontends register {axis name: size} when a plan materializes,
+# so the ring model prices the mesh that will actually run, not a guess
+_AXIS_SIZES: dict[str, int] = {}
+
+
+def set_axis_sizes(sizes: Optional[dict]) -> None:
+    """Register (or clear, with None/{}) mesh axis sizes for collective
+    pricing: ``set_axis_sizes({"dp": 8, "tp": 4})``."""
+    _AXIS_SIZES.clear()
+    if sizes:
+        _AXIS_SIZES.update({str(k): int(v) for k, v in sizes.items()})
+
+
+def _collective_world_size(bsym) -> int:
+    """Participant count N for a collective bsym: the ``world_size`` kwarg
+    when the prim carries one, else the registered size of its mesh axis,
+    else 2 — the smallest real multi-device mesh, which reproduces the old
+    one-buffer-width model for an all-reduce instead of zeroing comms."""
+    kwargs = getattr(bsym, "kwargs", None) or {}
+    ws = kwargs.get("world_size")
+    if ws is None:
+        axis = kwargs.get("axis")
+        if axis is None:
+            axis = next((a for a in getattr(bsym, "args", ()) or ()
+                         if isinstance(a, str)), None)
+        if axis is not None:
+            ws = _AXIS_SIZES.get(str(axis))
+    try:
+        n = int(ws)
+    except (TypeError, ValueError):
+        n = 0
+    return n if n >= 2 else 2
+
+
+# bytes a ring algorithm moves per participant, as a multiple of the full
+# buffer S (NCCL/ICI accounting): all-reduce = reduce-scatter + all-gather
+# = 2(N-1)/N * S; one-pass collectives move (N-1)/N * S
+_COLL_TWO_PASS = ("all_reduce", "pmean")
+_COLL_ONE_PASS = ("all_gather", "reduce_scatter", "all_to_all")
+
+
+def collective_bytes(bsym) -> int:
+    """ICI bytes one participant moves for a collective, per the ring
+    model. S is the FULL (post-gather / pre-scatter) buffer — the max
+    single-tensor size on the interface, so a sharded input doesn't halve
+    an all-gather's priced traffic."""
+    op = str(getattr(bsym.sym, "id", None) or bsym.sym.name)
+    tail = op.rsplit(".", 1)[-1]
+    n = _collective_world_size(bsym)
+    size = max(
+        [_tensor_nbytes(p) for p in bsym.flat_proxy_args()]
+        + [_tensor_nbytes(p) for p in bsym.flat_proxy_outs()]
+        + [0])
+    if tail in _COLL_TWO_PASS:
+        factor = 2.0 * (n - 1) / n
+    elif tail in _COLL_ONE_PASS:
+        factor = (n - 1) / n
+    else:
+        # broadcast / ppermute / synchronize barriers: one buffer width
+        factor = 1.0
+    return int(size * factor)
+
+
 def _prim_cost_table():
     """PrimID -> flops fn. Built lazily: prims imports symbol (cycle)."""
     from ..core.prims import PrimIDs as P
@@ -192,8 +259,10 @@ def bsym_cost(bsym) -> dict:
     if OpTags.REDUCTION_OP in tags:
         return {"flops": _reduction_flops(bsym), "bytes": _io_bytes(bsym)}
     if OpTags.COLLECTIVE in tags:
-        # collectives move bytes over ICI; arithmetic is the reduce itself
-        return {"flops": float(_out_numel(bsym)), "bytes": _io_bytes(bsym)}
+        # collectives move bytes over ICI per the ring model (an N-way
+        # all-reduce moves 2(N-1)/N of the buffer, not one buffer width);
+        # arithmetic is the reduce itself
+        return {"flops": float(_out_numel(bsym)), "bytes": collective_bytes(bsym)}
     if bsym.subsymbols:
         flops = sum(bsym_cost(s)["flops"] for s in bsym.subsymbols)
         return {"flops": flops, "bytes": _io_bytes(bsym)}

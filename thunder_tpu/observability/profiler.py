@@ -165,6 +165,17 @@ class RegionTime:
     intensity: Optional[float] = None
     roofline: str = ""
     mfu: Optional[float] = None
+    # comms-only concurrency split: of this region's collective/transfer
+    # device time, how much ran concurrently with ANY compute slice on the
+    # same device row (overlapped — hidden behind compute) vs serialized
+    # against it (exposed — the part lever ROADMAP#5a can actually recover)
+    overlapped_us: float = 0.0
+    exposed_us: float = 0.0
+
+    @property
+    def overlap_frac(self) -> Optional[float]:
+        comms = self.overlapped_us + self.exposed_us
+        return (self.overlapped_us / comms) if comms else None
 
     def as_dict(self) -> dict:
         return {
@@ -174,6 +185,10 @@ class RegionTime:
             "intensity": None if self.intensity is None else round(self.intensity, 3),
             "roofline": self.roofline,
             "mfu": None if self.mfu is None else round(self.mfu, 4),
+            "overlapped_us": round(self.overlapped_us, 3),
+            "exposed_us": round(self.exposed_us, 3),
+            "overlap_frac": (None if self.overlap_frac is None
+                             else round(self.overlap_frac, 4)),
         }
 
 
@@ -188,10 +203,19 @@ class DeviceProfile:
     unattributed_us: float = 0.0
     wall_us: float = 0.0
     peak_tflops: float = 0.0
+    overlapped_comms_us: float = 0.0
+    exposed_comms_us: float = 0.0
 
     @property
     def attributed_us(self) -> float:
         return self.total_device_us - self.unattributed_us
+
+    @property
+    def overlap_frac(self) -> Optional[float]:
+        """Fraction of collective+transfer device time hidden behind
+        compute (None when the window had no comms at all)."""
+        comms = self.overlapped_comms_us + self.exposed_comms_us
+        return (self.overlapped_comms_us / comms) if comms else None
 
     @property
     def attributed_frac(self) -> Optional[float]:
@@ -222,6 +246,10 @@ class DeviceProfile:
             "collective_us": round(self.categories.get("collective", 0.0), 1),
             "transfer_us": round(self.categories.get("transfer", 0.0), 1),
             "unattributed_us": round(self.unattributed_us, 1),
+            "overlapped_comms_us": round(self.overlapped_comms_us, 1),
+            "exposed_comms_us": round(self.exposed_comms_us, 1),
+            "overlap_frac": (None if self.overlap_frac is None
+                             else round(self.overlap_frac, 4)),
             "attributed_frac": (None if self.attributed_frac is None
                                 else round(self.attributed_frac, 4)),
             "mfu_measured": (lambda m: None if m is None else round(m, 4))(
@@ -253,6 +281,11 @@ class DeviceProfile:
         if self.unattributed_us:
             lines.append(f"  {'(unattributed)':<28} {self.unattributed_us / 1e3:>8.3f}ms "
                          f"{100 * self.unattributed_us / tot:>5.1f}%")
+        if self.overlap_frac is not None:
+            lines.append(
+                f"  comms overlap: {self.overlap_frac:.0%} hidden "
+                f"({self.overlapped_comms_us / 1e3:.3f} ms overlapped, "
+                f"{self.exposed_comms_us / 1e3:.3f} ms exposed)")
         return "\n".join(lines)
 
     def emit(self) -> None:
@@ -288,6 +321,30 @@ def _classify(name: str, args: dict) -> str:
     return "compute"
 
 
+def _merge_intervals(ivals: list) -> list:
+    """Sorted disjoint union of (start, end) intervals."""
+    out: list = []
+    for start, end in sorted(ivals):
+        if out and start <= out[-1][1]:
+            if end > out[-1][1]:
+                out[-1] = (out[-1][0], end)
+        else:
+            out.append((start, end))
+    return out
+
+
+def _overlap_len(start: float, end: float, union: Iterable) -> float:
+    """Total length of [start, end) covered by a sorted disjoint union."""
+    total = 0.0
+    for s, e in union:
+        if e <= start:
+            continue
+        if s >= end:
+            break
+        total += min(end, e) - max(start, s)
+    return total
+
+
 def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
               n_steps: int = 1) -> DeviceProfile:
     """Join device-side trace events to registered regions.
@@ -320,6 +377,11 @@ def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
     region_times: dict[str, RegionTime] = {}
     t_min = None
     t_max = None
+    # concurrency sweep inputs, collected per device row (pid) so two
+    # devices' slices can't fake an overlap with each other: compute slice
+    # intervals, and each comms slice with its eventual region target
+    compute_ivals: dict[Any, list] = {}  # pid -> [(start, end), ...]
+    comms_slices: list = []  # (pid, start_or_None, dur, target_name_or_None)
 
     for ev in trace_events:
         if ev.get("ph") != "X":
@@ -336,6 +398,8 @@ def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
         cat = _classify(name, args)
         prof.total_device_us += dur
         prof.categories[cat] = prof.categories.get(cat, 0.0) + dur
+        if cat == "compute" and ts is not None and dur > 0:
+            compute_ivals.setdefault(ev.get("pid"), []).append((ts, ts + dur))
 
         target = None
         hay = name + " " + " ".join(str(v) for v in args.values())
@@ -346,6 +410,8 @@ def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
             if rname in hay:
                 target = rname
                 break
+        if cat != "compute":
+            comms_slices.append((ev.get("pid"), ts, dur, target))
         if target is None:
             prof.unattributed_us += dur
             continue
@@ -361,6 +427,25 @@ def attribute(trace_events: list[dict], *, region_map: Optional[dict] = None,
         rt.us += dur
         rt.count += 1
         rt.cat_us[cat] = rt.cat_us.get(cat, 0.0) + dur
+
+    # concurrency sweep: merge each device row's compute slices into a
+    # disjoint interval union, then split every comms slice into the part
+    # inside the union (overlapped — hidden behind compute) and the rest
+    # (exposed). Slices without a timestamp can't prove concurrency and
+    # count fully exposed.
+    compute_union = {pid: _merge_intervals(iv) for pid, iv in compute_ivals.items()}
+    for pid, ts, dur, target in comms_slices:
+        if ts is None or dur <= 0:
+            overlapped = 0.0
+        else:
+            overlapped = _overlap_len(ts, ts + dur, compute_union.get(pid, ()))
+        exposed = max(0.0, dur - overlapped)
+        prof.overlapped_comms_us += overlapped
+        prof.exposed_comms_us += exposed
+        if target is not None and target in region_times:
+            rt = region_times[target]
+            rt.overlapped_us += overlapped
+            rt.exposed_us += exposed
 
     for rt in region_times.values():
         # a region's category is where its TIME went, not whatever its last

@@ -53,6 +53,11 @@ def make_mesh(axis_sizes: dict[str, int], *, devices=None) -> Mesh:
             f"call every computation on this mesh or the fleet hangs",
             stacklevel=2)
     arr = np.asarray(devices[:n]).reshape(sizes)
+    # register axis sizes with the cost model so collectives without a
+    # world_size kwarg (dist.all_reduce) price the mesh that will run
+    from ..observability import flops as _flops
+
+    _flops.set_axis_sizes(dict(axis_sizes))
     return Mesh(arr, names)
 
 

@@ -30,6 +30,14 @@ Fleet observability (ISSUE 17) adds a sixth, non-destructive kind:
               straggler detector can name the cause, exercising the
               detect-and-triage path end to end.
 
+Memory observability (ISSUE 18) adds a seventh:
+
+  oom         raise a RESOURCE_EXHAUSTED-shaped XlaRuntimeError at dispatch
+              time, the exact shape the device allocator produces — so the
+              OOM post-mortem path (observability/memory_watch.py forensic
+              bundle + ``oom`` cause) is deterministically testable like
+              every other recovery path. ``oom@3:host=1`` OOMs only host 1.
+
   ``:host=<p>`` scopes any fault to one process of a multi-process run
   (``nan_loss@5:host=1`` poisons only host 1's batch — the psum'd guard
   gate must still skip the step on EVERY host). Unscoped faults fire on
@@ -62,7 +70,7 @@ from typing import Optional
 
 import numpy as np
 
-KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt", "die", "slow")
+KINDS = ("nan_loss", "transient", "ckpt_fail", "preempt", "die", "slow", "oom")
 
 # default per-step delay for a bare `slow@N` fault (no explicit `(ms)` arg)
 DEFAULT_SLOW_MS = 50.0
@@ -307,6 +315,31 @@ def maybe_sleep(step: int) -> None:
     import time
 
     time.sleep(ms / 1e3)
+
+
+def _oom_exc_type():
+    """The real XlaRuntimeError when the runtime provides it (so catch sites
+    and ``memory_watch.is_oom`` see the genuine type), else a stand-in with
+    the same __name__."""
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError  # type: ignore
+
+        return XlaRuntimeError
+    except Exception:  # noqa: BLE001 - jaxlib layout drift: shape-only fake
+        return type("XlaRuntimeError", (RuntimeError,), {})
+
+
+def maybe_oom(step: int) -> None:
+    """oom site: raise the allocator's RESOURCE_EXHAUSTED shape at dispatch
+    time — message modeled on the real TPU OOM ("Attempting to allocate
+    ...") so the post-mortem path is exercised against what production
+    actually throws, not a sanitized stand-in."""
+    if _PLAN is None or not _PLAN.should_fire("oom", step):
+        return
+    exc_type = _oom_exc_type()
+    raise exc_type(
+        f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"17179869184 bytes. [injected oom fault at step {step}]")
 
 
 def maybe_preempt(step: int) -> None:

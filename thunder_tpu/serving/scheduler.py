@@ -65,6 +65,7 @@ import numpy as np
 from ..compile_service.buckets import BucketLadder
 from ..observability import events as _obs
 from ..observability import flight_recorder as _obs_flight
+from ..observability import memory_watch as _obs_mem
 from ..observability import metrics as _obs_metrics
 from ..observability import runtime as _obs_runtime
 from ..observability import telemetry as _obs_tel
@@ -300,6 +301,12 @@ class ServingEngine:
         self.requests_retired = 0       # non-cancelled retirements
         self.requests_slo_met = 0
 
+        # OOM forensics: hand the memory watcher a live view of the page
+        # pool so a RESOURCE_EXHAUSTED bundle names pool pressure and
+        # fragmentation, not just device bytes (last engine wins — one
+        # engine per process is the deployed shape)
+        _obs_mem.register_pool_state(self._pool_state)
+
     # -- public API -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, *, temperature: float = 0.0,
                seed: Optional[int] = None, eos_id: Optional[int] = None,
@@ -423,6 +430,7 @@ class ServingEngine:
             "page_pool_utilization": round(self.cache.utilization(), 4),
             "peak_page_pool_utilization": round(self.peak_pages_in_use / usable, 4)
             if usable else 0.0,
+            "page_fragmentation": round(self.page_fragmentation(), 4),
             "active": sum(1 for s in self._slots if s is not None),
             "pending": len(self._pending) + len(self._pending_batch),
             "chunking": len(self._chunking),
@@ -445,6 +453,45 @@ class ServingEngine:
                               if self.requests_retired else None)
             out["slo"] = self.slo_monitor.status()
         return out
+
+    def page_fragmentation(self) -> float:
+        """Internal fragmentation of the page pool: the fraction of
+        allocated page capacity NOT holding resident tokens. Worst-case
+        lifetime reservation at admission means a request holds
+        ``bucket + growth`` pages from its first prefill, so early in a
+        long generation most of its reserved capacity is air — this gauge
+        is the difference between "the pool is full" and "the pool is full
+        of tokens", which picks between raising n_pages and tightening
+        admission."""
+        n_used = self.cache.allocator.n_used
+        if not n_used:
+            return 0.0
+        resident = 0
+        # lock-free slot scan: a torn read skews one gauge sample, while
+        # taking self._lock here would deadlock callers that already hold
+        # it (the post-mortem path can fire from anywhere)
+        for req in list(self._slots):
+            if req is None:
+                continue
+            prompt = req.prompt_eff if req.prompt_eff is not None else req.prompt
+            resident += len(prompt) + len(req.tokens)
+        frac = 1.0 - resident / (n_used * self.page_size)
+        return max(0.0, min(1.0, frac))
+
+    def _pool_state(self) -> dict:
+        """Page-pool snapshot for OOM forensic bundles (memory_watch)."""
+        usable = self.cache.n_pages - 1
+        return {
+            "pages_in_use": self.cache.allocator.n_used,
+            "n_pages": self.cache.n_pages,
+            "page_size": self.page_size,
+            "utilization": round(self.cache.utilization(), 4),
+            "peak_utilization": (round(self.peak_pages_in_use / usable, 4)
+                                 if usable else 0.0),
+            "fragmentation": round(self.page_fragmentation(), 4),
+            "active": sum(1 for s in self._slots if s is not None),
+            "pending": len(self._pending) + len(self._pending_batch),
+        }
 
     def goodput(self) -> Optional[float]:
         """Cumulative fraction of retired (non-cancelled) requests whose
@@ -720,6 +767,10 @@ class ServingEngine:
     def _fail(self, req: _Request, exc: Exception) -> None:
         """Contain one request's failure: return its pages, fail its Future
         (waiters see the error instead of hanging), keep the engine alive."""
+        # RESOURCE_EXHAUSTED through serving dispatch: dump the forensic
+        # bundle (census + page-pool state) BEFORE freeing this request's
+        # pages, so the bundle shows the pool as the allocator saw it
+        _obs_mem.maybe_post_mortem(exc, step=self.decode_steps, source="serve")
         if req.pages:
             self.cache.allocator.free(req.pages)
             req.pages = []
@@ -788,6 +839,8 @@ class ServingEngine:
             _obs_tel.observe("serve.prefill_ms", (t_done - t0) * 1e3)
             _obs_tel.set_gauge("serve.pool_utilization", util)
             _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
+            _obs_tel.set_gauge("serve.page_fragmentation",
+                               round(self.page_fragmentation(), 4))
             _obs_trace.trace_event(req.trace_id, "prefill",
                                    request=req.request_id,
                                    dur_ms=(t_done - t0) * 1e3, bucket=bucket,
@@ -899,6 +952,8 @@ class ServingEngine:
             _obs_tel.set_gauge("serve.pool_utilization", util)
             _obs_tel.set_gauge("serve.pages_in_use",
                                self.cache.allocator.n_used)
+            _obs_tel.set_gauge("serve.page_fragmentation",
+                               round(self.page_fragmentation(), 4))
         if req.tokens:
             self._on_resume(req)
             self._activate(req, slot, pos=L_eff, tok=req.tokens[-1])
@@ -1159,6 +1214,8 @@ class ServingEngine:
             util = round(self.cache.utilization(), 4)
             _obs_tel.set_gauge("serve.pool_utilization", util)
             _obs_tel.set_gauge("serve.pages_in_use", self.cache.allocator.n_used)
+            _obs_tel.set_gauge("serve.page_fragmentation",
+                               round(self.page_fragmentation(), 4))
             if self.slo_policy is not None and self.requests_retired:
                 _obs_tel.set_gauge(
                     "serve.goodput",

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .nn.module import Module, ThunderModule, structure_epoch
 from .observability import events as _obs
 from .observability import flight_recorder as _obs_flight
+from .observability import memory_watch as _obs_mem
 from .observability import metrics as _obs_metrics
 from .observability import runtime as _obs_runtime
 from .observability import telemetry as _obs_tel
@@ -542,8 +543,11 @@ class TrainStep:
             if _rb_faults.active():
                 # `die` kills the process mid-step (host-death injection) —
                 # deliberately OUTSIDE any retry loop: a dead host does not
-                # retry, its peers discover it through the runtime
+                # retry, its peers discover it through the runtime; `oom`
+                # likewise — an exhausted allocator does not recover on the
+                # next attempt, the post-mortem path owns it
                 _rb_faults.maybe_die(step_idx)
+                _rb_faults.maybe_oom(step_idx)
                 _rb_faults.maybe_raise("transient", step_idx)
             return self._jitted(*jit_args)
 
@@ -556,6 +560,7 @@ class TrainStep:
 
         if _rb_faults.active():
             _rb_faults.maybe_die(step_idx)
+            _rb_faults.maybe_oom(step_idx)
 
         return g.run_with_retry(attempt, step=step_idx)
 
@@ -623,7 +628,12 @@ class TrainStep:
                 with _obs.span("train_step") if sampled else _NULL_SPAN:
                     out = self._dispatch(
                         tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)
-            except BaseException:
+            except BaseException as e:
+                # RESOURCE_EXHAUSTED through dispatch: dump the forensic
+                # bundle (live-array census, watermark ring, budget
+                # estimate) BEFORE re-raising — the step is already dead,
+                # the only question is whether the crash is legible
+                _obs_mem.maybe_post_mortem(e, step=step_idx, source="train")
                 # a step that dies while the FLEET is draining (a preempted
                 # peer stopped stepping, so this host's collective had no
                 # counterparty) is the drain arriving, not a crash: finalize
@@ -664,6 +674,9 @@ class TrainStep:
                 _obs_flight.record_step(wall_ms, step=self._step_count,
                                         fn="train_step")
                 _obs_tel.observe("train.step_ms", wall_ms)
+                # HBM watermark sample at the step boundary (mem.* gauges +
+                # watermark ring); gated on the same obs_on read
+                _obs_mem.on_step(self._step_count, source="train")
             if slo_mon is not None:
                 slo_mon.observe_step(wall_ms)
         if gmetrics is not None:

@@ -5,7 +5,9 @@ per-process shards, or the bench artifact OBS_TIMELINE.jsonl) and renders
 the views an operator actually wants: the compile-phase span tree with
 durations, cache traffic and recompile reasons, step-latency statistics,
 a per-host fleet breakdown (step latency + straggler flags per shard),
-the ``perf`` subcommand's device-time/FLOPs view, and the ``trace``
+a memory section (watermarks, pressure crossings, estimate drift, OOM
+bundles, live-array census), the ``perf`` subcommand's device-time/FLOPs
+view (with per-region comms-overlap columns), and the ``trace``
 subcommand's end-to-end request timeline (submitted -> ... -> retired,
 optionally exported as Chrome trace-event JSON for chrome://tracing).
 
@@ -531,19 +533,27 @@ def render_perf(recs: list[dict]) -> str:
         if p.get("mfu_measured") is not None:
             head += f"  mfu_measured={p['mfu_measured']:.3f}"
         out.append(head)
+        if p.get("overlap_frac") is not None:
+            out.append(
+                f"  comms overlap: {p['overlap_frac']:.0%} hidden behind "
+                f"compute  (overlapped={p.get('overlapped_comms_us', 0) / 1e3:.3f}ms"
+                f"  exposed={p.get('exposed_comms_us', 0) / 1e3:.3f}ms)")
         out.append(f"  {'region':<28} {'time':>10} {'%':>6} {'calls':>6} "
-                   f"{'category':<10} {'GFLOP':>8} {'AI':>7} {'roofline':<13} {'mfu':>6}")
+                   f"{'category':<10} {'GFLOP':>8} {'AI':>7} {'roofline':<13} "
+                   f"{'mfu':>6} {'overlap':>8}")
         regions = p.get("regions") or {}
         for name, r in sorted(regions.items(), key=lambda kv: -(kv[1].get("us") or 0)):
             us = r.get("us") or 0.0
             ai = r.get("intensity")
             mfu = r.get("mfu")
+            ovf = r.get("overlap_frac")
             out.append(
                 f"  {name:<28} {us / 1e3:>8.3f}ms "
                 f"{100 * us / tot if tot else 0:>5.1f}% {r.get('count', 0):>6} "
                 f"{r.get('category', ''):<10} {(r.get('flops') or 0) / 1e9:>8.2f} "
                 f"{'-' if ai is None else f'{ai:.1f}':>7} {r.get('roofline', ''):<13} "
-                f"{'-' if mfu is None else f'{mfu:.3f}':>6}")
+                f"{'-' if mfu is None else f'{mfu:.3f}':>6} "
+                f"{'-' if ovf is None else f'{ovf:.0%}':>8}")
         out.append("")
     steps = step_stats(recs)
     if steps:
@@ -555,6 +565,66 @@ def render_perf(recs: list[dict]) -> str:
         return ("(no device_profile records — capture one with "
                 "observability.profile_steps(...) or BENCH_OBS=1)")
     return "\n".join(out)
+
+
+def _gb(n) -> str:
+    return f"{(n or 0) / 2**30:.3f} GiB"
+
+
+def mem_lines(recs: list[dict], counters: dict) -> list[str]:
+    """The memory section: watermark high-water from ``mem_sample`` events,
+    pressure transitions, estimate-vs-measured drift, OOM post-mortems
+    (with their bundle paths), and the latest deep live-array census."""
+    samples, pressure, drifts, ooms, census = [], [], [], [], []
+    for r in recs:
+        if r.get("kind") != "event":
+            continue
+        name = r.get("name")
+        if name == "mem_sample":
+            samples.append(r)
+        elif name == "mem_pressure":
+            pressure.append(r)
+        elif name == "mem.estimate_drift":
+            drifts.append(r)
+        elif name == "oom":
+            ooms.append(r)
+        elif name == "mem_census":
+            census.append(r)
+    out = []
+    if samples:
+        last = samples[-1]["attrs"]
+        peak = max((s["attrs"].get("peak_bytes_in_use") or 0) for s in samples)
+        out.append(f"  peak bytes_in_use {_gb(peak)}  "
+                   f"(last sample {_gb(last.get('bytes_in_use'))} at step "
+                   f"{last.get('step')}, source={last.get('mem_source', '?')}, "
+                   f"{len(samples)} watermark sample(s))")
+    n_pressure = counters.get("mem.pressure", len(pressure))
+    if n_pressure:
+        a = pressure[-1]["attrs"] if pressure else {}
+        util = a.get("utilization")
+        out.append(f"  memory pressure transitions {n_pressure}"
+                   + (f"  (last at {util:.0%} of bytes_limit, step "
+                      f"{a.get('step')})" if util is not None else ""))
+    for d in drifts[-3:]:
+        a = d.get("attrs") or {}
+        out.append(f"  estimate drift: measured "
+                   f"{_gb(a.get('measured_peak_bytes'))} vs estimated "
+                   f"{_gb(a.get('estimated_peak_bytes'))} "
+                   f"(x{a.get('ratio', '?')}, {a.get('context') or a.get('source', '?')})")
+    for o in ooms:
+        a = o.get("attrs") or {}
+        out.append(f"  OOM at step {a.get('step')} ({a.get('source', '?')}): "
+                   f"{(a.get('error') or '')[:80]}")
+        if a.get("bundle"):
+            out.append(f"    forensic bundle: {a['bundle']}")
+    if census:
+        groups = (census[-1].get("attrs") or {}).get("groups") or []
+        if groups:
+            out.append("  live arrays (top by bytes, latest census):")
+            for g in groups[:6]:
+                out.append(f"    {str(g.get('shape')):<24} {g.get('dtype', ''):<10} "
+                           f"x{g.get('count', 0):<5} {_gb(g.get('bytes'))}")
+    return out
 
 
 def render(recs: list[dict], top: int = 0) -> str:
@@ -595,12 +665,16 @@ def render(recs: list[dict], top: int = 0) -> str:
     fleet = fleet_lines(recs, counters)
     if fleet:
         out += ["", "== fleet ==", *fleet]
+    mem = mem_lines(recs, counters)
+    if mem:
+        out += ["", "== memory ==", *mem]
     other = {k: v for k, v in counters.items()
              if not k.startswith("recompile.") and not k.startswith("serve.")
              and not k.startswith("slo.breach.") and not k.startswith("artifact.")
              and not k.startswith("compile.") and not k.startswith("checkpoint.")
              and not k.startswith("desync.") and not k.startswith("guard.dist_")
              and not k.startswith("fleet.") and not k.startswith("trace.")
+             and not k.startswith("mem.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]

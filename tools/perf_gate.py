@@ -11,12 +11,15 @@ Direction vocabulary (keys not listed are informational and never gated):
 
   higher is better   value (the row's headline throughput), tokens/s,
                      goodput, requests_per_s, requests_per_s_slo_met, mfu,
-                     mfu_measured, tflops_per_sec, vs_baseline
+                     mfu_measured, tflops_per_sec, vs_baseline,
+                     overlap_frac (comms hidden behind compute)
   lower is better    ttft_ms_*, tbot_ms_*, compile_time_s,
                      compile_time_warm_s, host_overhead_us, obs_overhead_us
                      (the disabled-tracing hot-path cost), ms_per_token,
                      mem_peak_estimated (the live-range peak-HBM estimate —
                      estimator regressions gate like perf regressions),
+                     mem_peak_measured (its measured twin),
+                     exposed_comms_us (serialized collective device time),
                      recompiles_steady_state (zero-tolerance: any increase
                      over the committed count is a regression)
 
@@ -53,7 +56,12 @@ HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
                  # warm starts must keep being served FROM THE STORE: a hit
                  # count falling to zero means the compile service silently
                  # stopped engaging even if wall time still looks ok
-                 "artifact_hits_warm")
+                 "artifact_hits_warm",
+                 # comms-overlap attribution (observability/profiler.py):
+                 # the fraction of collective/transfer device time hidden
+                 # behind compute — ROADMAP #5a pushes this UP; a scheduler
+                 # or partitioner change that serializes comms must fail CI
+                 "overlap_frac")
 LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
 LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
                 "ms_per_token", "mem_peak_estimated",
@@ -72,7 +80,14 @@ LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
                 # the `ms` of the checkpoint_save done event): distributed
                 # sharded saves must not silently regress what the step loop
                 # pays — the "ms" in the key gives it the latency slack floor
-                "ckpt_save_ms")
+                "ckpt_save_ms",
+                # exposed (not-overlapped-with-compute) collective device
+                # time per profiled window — the numerator of the comms tax
+                "exposed_comms_us",
+                # measured peak memory (device allocator high-water mark, or
+                # host RSS on backends without memory_stats): the measured
+                # twin of mem_peak_estimated gates the same way
+                "mem_peak_measured")
 ZERO_TOLERANCE = ("recompiles_steady_state",)
 # keys whose disappearance from the current artifact means the producer
 # broke — the live-range estimator raising, or the artifact store silently
