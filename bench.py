@@ -270,6 +270,7 @@ def _bench_fused(model_name: str, B: int, T: int, iters: int, warmup: int):
     return {
         "tps": tps,
         "loss": loss_val,
+        "platform": jax.devices()[0].platform,
         "compile_time_s": round(compile_time_s, 1),
         "artifact_stats": artifact_stats,
         "flops_per_token": _flops_per_token(cfg, T),
@@ -477,6 +478,101 @@ def _obs_row() -> dict:
             row["exposed_comms_us"] = db["exposed_comms_us"]
         if db.get("overlap_frac") is not None:
             row["overlap_frac"] = db["overlap_frac"]
+    of = row.get("overlap_frac")
+    if of is not None and of < 0.85 and fused.get("platform") == "cpu":
+        row["note"] = (
+            "overlap_frac under the 0.85 target because this window ran on "
+            "the CPU host backend: the per-backend probe in "
+            "parallel/overlap.py drops all six latency-hiding/async-"
+            "collective XLA options as unsupported there, so the measured "
+            "fraction is the CPU backend's default schedule — the overlap "
+            "levers (latency-hiding scheduler + async collectives) only "
+            "engage on TPU, where the same gspmd step requests them.")
+    return row
+
+
+def _mfu_row(spec: str) -> dict:
+    """One profiled training config for BENCH_MFU.json (BENCH_MFU=1): the
+    measured-MFU row the ISSUE-19 gate holds a baseline against. Spec
+    ``model:B:T[:gspmd]`` — the gspmd tag runs the GSPMD road on a dp-wide
+    virtual mesh (BENCH_DP, default 2) with the collective-overlap compiler
+    options armed (parallel/overlap.py), so ``overlap_frac`` /
+    ``exposed_comms_us`` measure the latency-hiding scheduler's work.
+
+    ``value`` is ``mfu_measured``: model FLOPs over MEASURED device time
+    from the profiled window, against the platform peak
+    (observability/flops.py DEVICE_PEAKS). When the row lands under the
+    0.60 target, ``note`` states the blocking roofline bound explicitly."""
+    import tempfile
+
+    parts = spec.split(":")
+    model_name, B, T = parts[0], int(parts[1]), int(parts[2])
+    gspmd = "gspmd" in parts[3:]
+    iters = int(os.environ.get("BENCH_MFU_ITERS", "3"))
+    dp = max(2, int(os.environ.get("BENCH_DP", "2"))) if gspmd else 1
+    if gspmd:
+        os.environ["BENCH_ROAD"] = "gspmd"
+        os.environ["BENCH_DP"] = str(dp)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={dp}").strip()
+    else:
+        # a prior gspmd spec in the same BENCH_MFU run must not leak its
+        # road/mesh into this single-device subprocess
+        os.environ.pop("BENCH_ROAD", None)
+        os.environ.pop("BENCH_DP", None)
+    scratch = tempfile.NamedTemporaryFile(
+        prefix="tt_bench_mfu_", suffix=".jsonl", delete=False)
+    scratch.close()
+    os.environ["BENCH_OBS_ARTIFACT"] = scratch.name
+    try:
+        fused = _run_phase("fused", model_name, B, T, iters)
+    finally:
+        try:
+            os.unlink(scratch.name)
+        except OSError:
+            pass
+    road_tag = f"gspmd road, dp={dp}, overlap scheduling" if gspmd else "single-device"
+    row = {
+        "metric": f"{model_name} measured MFU (B={B}, T={T}, {road_tag}, "
+                  f"fwd+bwd+adamw, profiled 3-step window)",
+        "value": fused.get("mfu_measured"),
+        "unit": "mfu",
+        "platform": fused.get("platform"),
+        "tokens_per_sec": round(fused["tps"], 1),
+        "peak_tflops": fused.get("peak_tflops"),
+    }
+    if fused.get("mfu_measured") is not None:
+        row["mfu_measured"] = fused["mfu_measured"]
+    db = fused.get("device_breakdown")
+    if db is not None:
+        row["device_breakdown"] = db
+        if db.get("exposed_comms_us") is not None:
+            row["exposed_comms_us"] = db["exposed_comms_us"]
+        if db.get("overlap_frac") is not None:
+            row["overlap_frac"] = db["overlap_frac"]
+    mfu = row.get("mfu_measured")
+    if mfu is not None and mfu < 0.60 and fused.get("platform") == "cpu":
+        # mfu_measured is judged against DEVICE_PEAKS["cpu"] = 1.0 TFLOP/s
+        # (observability/flops.py), NOT bench's TPU-style peak_tflops column
+        sustained = round(mfu * 1.0 * 1e3, 1)
+        note = (
+            f"Under the 0.60 target because the window ran on the CPU host "
+            f"backend: single-core XLA sustained ~{sustained} GFLOP/s against "
+            f"the nominal 1.0 TFLOP/s 'cpu' peak (observability/flops.py "
+            f"DEVICE_PEAKS) — a host compute-roofline bound, not a "
+            f"scheduling gap; the overlap/attribution columns are the "
+            f"portable evidence. TPU-measured MFU for this compiler is "
+            f"committed in BENCH_FP8.json (llama-350m fwd+bwd: 0.493 bf16 / "
+            f"0.41 fp8 on v5e).")
+        if gspmd:
+            note += (
+                " overlap_frac here is the CPU backend's default schedule: "
+                "the probe in parallel/overlap.py drops all six latency-"
+                "hiding/async-collective compiler options as unsupported on "
+                "CPU, so the overlap levers only engage on TPU.")
+        row["note"] = note
     return row
 
 
@@ -545,6 +641,33 @@ def main():
             json.dump([row], f, indent=1, sort_keys=True)
             f.write("\n")
         print(json.dumps(row), flush=True)
+        print(f"# wrote {out_path}", file=sys.stderr)
+        return
+
+    if os.environ.get("BENCH_MFU") == "1":
+        # measured-MFU artifact (ISSUE 19): profiled training configs with
+        # the overlap levers armed; best config first so perf_gate's
+        # higher-is-better mfu_measured baseline tracks the headline row.
+        # Regenerate with BENCH_MFU=1 python bench.py
+        # (BENCH_MFU_ROWS="model:B:T[:gspmd],..." overrides the configs).
+        specs = os.environ.get(
+            "BENCH_MFU_ROWS", "tiny-llama2:2:128:gspmd,tiny-llama2:4:128").split(",")
+        rows = []
+        for spec in specs:
+            try:
+                row = _mfu_row(spec)
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+            except Exception as e:
+                print(f"# mfu row {spec} failed: {e}", file=sys.stderr)
+        if not rows:
+            raise SystemExit("BENCH_MFU: every row failed")
+        rows.sort(key=lambda r: r.get("mfu_measured") or 0.0, reverse=True)
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_MFU.json")
+        with open(out_path, "w") as f:
+            json.dump(rows, f, indent=1, sort_keys=True)
+            f.write("\n")
         print(f"# wrote {out_path}", file=sys.stderr)
         return
 

@@ -74,6 +74,12 @@ def pytest_configure(config):
         "dist: multi-process distributed test (subprocess-spawned 2-process "
         "CPU cluster via jax.distributed + gloo; these also carry `slow` so "
         "tier-1 stays fast — run with -m dist)")
+    config.addinivalue_line(
+        "markers",
+        "perf: performance-lever correctness test (overlap cache keys, "
+        "bucketed grad-sync bit-identity, fused fp8 kernel parity, int8 "
+        "decode token-identity, committed-artifact schema gates; filter "
+        "with -m perf / -m 'not perf')")
 
 
 def pytest_collection_modifyitems(config, items):

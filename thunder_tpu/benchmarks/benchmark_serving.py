@@ -141,7 +141,9 @@ def run(args) -> dict:
                         spec_k=args.spec_k if args.self_draft else None)
     engine = ServingEngine(gpt, max_batch=args.max_batch, page_size=args.page_size,
                            max_seq=args.max_seq, dtype=dtype, slo=slo,
-                           n_pages=args.n_pages or None, **fleet_kw)
+                           n_pages=args.n_pages or None,
+                           quantize=None if args.quantize == "none" else args.quantize,
+                           **fleet_kw)
 
     rng = np.random.RandomState(args.seed)
     if args.workload == "mixed":
@@ -226,6 +228,8 @@ def run(args) -> dict:
     n_truncated = sum(1 for r in results if r.n_new_tokens <= 1)
     stats = engine.stats()
     workload_tag = "" if args.workload == "uniform" else f"{args.workload} workload, "
+    if args.quantize != "none":
+        workload_tag += f"{args.quantize} weight-quantized decode, "
     row = {
         "platform": jax.devices()[0].platform,
         "metric": (f"{args.model_name} serving aggregate new tokens/sec "
@@ -289,9 +293,21 @@ def run(args) -> dict:
                                if k.startswith("slo.breach.")}
     print(json.dumps(row, indent=1))
     if os.environ.get("BENCH_SERVE") == "1":
+        # merge-by-metric so variant runs (e.g. --quantize int8 next to the
+        # bf16 baseline) accumulate into one multi-row artifact instead of
+        # clobbering each other; perf_gate.load_rows handles both shapes
+        rows = []
+        if os.path.exists(args.artifact):
+            try:
+                with open(args.artifact) as f:
+                    old = json.load(f)
+                rows = old if isinstance(old, list) else [old]
+            except Exception:
+                rows = []
+        rows = [r for r in rows if r.get("metric") != row["metric"]] + [row]
         with open(args.artifact, "w") as f:
-            json.dump(row, f, indent=1)
-        print(f"wrote {args.artifact}")
+            json.dump(rows if len(rows) > 1 else row, f, indent=1)
+        print(f"wrote {args.artifact} ({len(rows)} row(s))")
     return row
 
 
@@ -331,6 +347,9 @@ def main():
     p.add_argument("--n_pages", type=int, default=0,
                    help="page-pool override (0 = engine default)")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--quantize", default="none", choices=["none", "int8"],
+                   help="weight-only quantization for the serving model "
+                        "(int8: dequant-in-kernel decode compute)")
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--artifact", default="BENCH_SERVE.json")

@@ -1348,6 +1348,12 @@ def _int8_linear_supported(x, qweight, scale, bias=None):
     M = 1
     for d in x.shape[:-1]:
         M *= int(d)
+    # the kernel is a TPU HBM-residency play; on CPU the interpret-mode
+    # pallas path is a per-call interpreter, far slower than XLA's
+    # dequant-matmul — serving benchmarks must measure the XLA path there
+    # (TT_INT8_PALLAS_CPU=1 re-enables the claim for kernel tests)
+    if not (_on_tpu() or os.environ.get("TT_INT8_PALLAS_CPU") == "1"):
+        return False
     # whole-M block (no M grid): claim the serving/decode regime; huge-M
     # prefill/training shapes stay on the XLA path (compute-bound there)
     return (
@@ -1370,6 +1376,136 @@ def _int8_linear_impl(x, qweight, scale, bias=None):
 
 ex.register_implementation("quant.linear_int8", _int8_linear_impl,
                            checker=_int8_linear_supported)
+
+
+# ===========================================================================
+# Fused fp8 delayed-scaling matmul (quantize + amax + matmul, one VMEM pass)
+# ===========================================================================
+#
+# The unfused delayed-scaling linear runs as FOUR device programs per call:
+# quantize(x), quantize(w), the fp8 dot, and a separate abs-max reduction
+# over each operand for the history roll — each streaming the operand
+# through HBM again. The profiler tags the quantize/amax passes memory-bound
+# (BENCH_FP8: the fp8 road measured 0.83x bf16 at 7B-shape width, i.e. the
+# scaling overhead ATE the matmul win). This kernel folds all of it into the
+# matmul's VMEM pass: each (block_m, block_k) x block and (block_n, block_k)
+# w block is cast to f32 once, clipped/scaled to e4m3, max-reduced into the
+# running amax, and fed to the MXU as bf16 (every e4m3 value is exactly
+# representable in bf16, so the dot is exact in f32 accumulation). The
+# quantized blocks are optionally written out as the saved-for-backward
+# residuals — the same bytes the unfused path materializes anyway.
+
+
+def _fp8_matmul_kernel(x_ref, w_ref, sx_ref, sw_ref, *refs,
+                       n_k: int, fmt_max: float, save_q: bool):
+    if save_q:
+        o_ref, xq_ref, wq_ref, ax_ref, aw_ref, acc_ref = refs
+    else:
+        o_ref, ax_ref, aw_ref, acc_ref = refs
+        xq_ref = wq_ref = None
+    i, j, k = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    sx = sx_ref[0, 0]
+    sw = sw_ref[0, 0]
+    xq = jnp.clip(x * sx, -fmt_max, fmt_max).astype(jnp.float8_e4m3fn)
+    wq = jnp.clip(w * sw, -fmt_max, fmt_max).astype(jnp.float8_e4m3fn)
+    if save_q:
+        # unconditional store: an x block is revisited once per j (w block
+        # once per i) and rewriting the same value sidesteps any
+        # leave-and-return output-revisit semantics
+        xq_ref[:] = xq
+        wq_ref[:] = wq
+
+    # amax of the UNQUANTIZED operands (feeds the delayed-scaling history).
+    # The (1, 1) output block is grid-resident (constant index map): init on
+    # the first program, then max-accumulate — revisits re-apply the same
+    # max, which is idempotent, so no j==0/i==0 gating is needed.
+    @pl.when((i == 0) & (j == 0) & (k == 0))
+    def _init_amax():
+        # explicit f32 literals: under jax_enable_x64 a bare 0.0 stores f64
+        ax_ref[0, 0] = jnp.float32(0.0)
+        aw_ref[0, 0] = jnp.float32(0.0)
+
+    ax_ref[0, 0] = jnp.maximum(ax_ref[0, 0], jnp.max(jnp.abs(x)))
+    aw_ref[0, 0] = jnp.maximum(aw_ref[0, 0], jnp.max(jnp.abs(w)))
+
+    @pl.when(k == 0)
+    def _zero_acc():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        xq.astype(jnp.bfloat16), wq.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _write_out():
+        o_ref[:] = (acc_ref[...] / (sx * sw)).astype(o_ref.dtype)
+
+
+def fp8_linear_fused(x2d, w, sx, sw, *, fmt_max: float = 448.0,
+                     save_quantized: bool = False,
+                     block_m: int = 256, block_n: int = 256, block_k: int = 512):
+    """Delayed-scaling fp8 linear: ``dequant(q(x2d) @ q(w).T)`` with the
+    operand amaxes reduced in the same pass.
+
+    Returns ``(y, amax_x, amax_w)`` — or ``(y, xq, wq, amax_x, amax_w)``
+    with ``save_quantized`` (the e4m3 residuals for the backward). ``sx`` /
+    ``sw`` are the precomputed delayed scales (scalars)."""
+    M, K = x2d.shape
+    N = w.shape[0]
+    bm = math.gcd(block_m, M)
+    bn = math.gcd(block_n, N)
+    bk = math.gcd(block_k, K)
+    n_k = K // bk
+    scalar_spec = pl.BlockSpec((1, 1), lambda i, j, k: (0, 0))
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
+    out_shape = [jax.ShapeDtypeStruct((M, N), x2d.dtype)]
+    if save_quantized:
+        out_specs += [pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                      pl.BlockSpec((bn, bk), lambda i, j, k: (j, k))]
+        out_shape += [jax.ShapeDtypeStruct((M, K), jnp.float8_e4m3fn),
+                      jax.ShapeDtypeStruct((N, K), jnp.float8_e4m3fn)]
+    out_specs += [scalar_spec, scalar_spec]
+    out_shape += [jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                  jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_fp8_matmul_kernel, n_k=n_k, fmt_max=fmt_max,
+                          save_q=save_quantized),
+        grid=(M // bm, N // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bn, bk), lambda i, j, k: (j, k)),
+            scalar_spec,
+            scalar_spec,
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)] if pltpu is not None else [],
+        interpret=_interpret(),
+    )(x2d, w,
+      jnp.asarray(sx, jnp.float32).reshape(1, 1),
+      jnp.asarray(sw, jnp.float32).reshape(1, 1))
+    if save_quantized:
+        y, xq, wq, ax, aw = outs
+        return y, xq, wq, ax[0, 0], aw[0, 0]
+    y, ax, aw = outs
+    return y, ax[0, 0], aw[0, 0]
+
+
+def fp8_linear_fused_supported(x2d, w) -> bool:
+    """Dispatch gate for the fp8 training executor: TPU (or forced via
+    TT_FP8_FUSED=force for interpret-mode testing), tile-aligned shapes.
+    The CPU/jnp unfused reference stays the fallback everywhere else."""
+    forced = os.environ.get("TT_FP8_FUSED", "") == "force"
+    if not (_on_tpu() or forced):
+        return False
+    if pltpu is None or getattr(x2d, "ndim", 0) != 2 or getattr(w, "ndim", 0) != 2:
+        return False
+    M, K = x2d.shape
+    N = w.shape[0]
+    return K % 128 == 0 and N % 128 == 0 and M % 8 == 0
 
 
 # ===========================================================================

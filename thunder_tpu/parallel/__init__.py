@@ -14,5 +14,7 @@ from .mesh import (
     single_device_mesh,
 )
 from . import multiprocess, prims
-from .gspmd import gspmd_step, shard_constraint
+from .bucketing import GradBucketingTransform
+from .gspmd import comms_bound_activation_specs, gspmd_step, shard_constraint
+from .overlap import OVERLAP_COMPILER_OPTIONS, resolve_overlap_options
 from .transforms import DDPTransform, DistPlan, FSDPTransform, ParamStrategy, ddp, fsdp

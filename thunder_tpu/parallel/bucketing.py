@@ -75,6 +75,12 @@ class GradBucketingTransform(Transform):
     def __init__(self, bucket_size_in_mb: float = 25.0):
         self.bucket_bytes = int(bucket_size_in_mb * 1024 * 1024)
 
+    def __repr__(self):
+        # the bucket size is program-identity: it decides which all-reduces
+        # merge, so it must ride _safe_repr-derived cache keys (a bucket-size
+        # flip regroups the collectives and must miss the AOT store)
+        return f"GradBucketingTransform(bucket_bytes={self.bucket_bytes})"
+
     def transform_trace_post_optimization(self, trc: TraceCtx, *, compile_data=None) -> TraceCtx:
         bsyms = trc.bound_symbols
         # names of proxies consumed anywhere except RETURN

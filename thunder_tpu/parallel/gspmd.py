@@ -93,6 +93,12 @@ class GspmdConstraintTransform(_Transform):
     def __init__(self, specs: dict):
         self.specs = dict(specs)
 
+    def __repr__(self):
+        # deterministic (no object address): this repr rides the AOT step
+        # key via training._safe_repr — same constraint set, same key
+        rules = ",".join(f"{k}:{v}" for k, v in sorted(self.specs.items()))
+        return f"GspmdConstraintTransform({rules})"
+
     def transform_traces_pre_autodiff(self, prologue_trc, computation_trc, *,
                                       compile_data=None):
         from ..core.trace_interpreter import TraceSubstitutionProcessor
@@ -115,24 +121,70 @@ class GspmdConstraintTransform(_Transform):
         return prologue_trc, new_trc
 
 
+def comms_bound_activation_specs(profile, plan, *, min_exposed_us: float = 0.0) -> dict:
+    """Profile-driven activation constraints: from a DeviceProfile
+    (observability/profiler.py attribute()), pick the regions whose roofline
+    tag says the time is comms-bound AND whose collective time is actually
+    exposed (serialized against compute), and pin their member symbols'
+    activations to the plan's batch-sharded layout.
+
+    The mechanism: a with_sharding_constraint on the activation a collective
+    feeds keeps the partitioner from round-tripping it through a replicated
+    layout (reshard -> collective -> reshard), which is where profiled
+    exposure hides on the gspmd road. Returns DistPlan.activation_specs
+    material — ``{symbol_id: partition-spec tuple}`` per distinct rank seen
+    in the region's cost metadata is not recoverable here, so the spec pins
+    dim 0 (the batch dim) and is applied by GspmdConstraintTransform only to
+    rank-matching outputs (specs are emitted for ranks 2..4)."""
+    if not getattr(plan, "data_axes", ()):
+        return {}
+    axis = plan.data_axes[0]
+    specs: dict = {}
+    regions = getattr(profile, "regions", None) or {}
+    for r in regions.values():
+        roofline = getattr(r, "roofline", "")
+        exposed = getattr(r, "exposed_us", 0.0)
+        if roofline != "comms-bound" or exposed < min_exposed_us:
+            continue
+        for sid in getattr(r, "bsym_ids", ()) or ():
+            # one rule per symbol id; GspmdConstraintTransform checks
+            # out.ndim == len(spec), so pick rank 3 (B, T, C activations) —
+            # the shape every transformer block boundary has
+            specs.setdefault(sid, (axis, None, None))
+    return specs
+
+
 # ---------------------------------------------------------------------------
 # GSPMD training step
 # ---------------------------------------------------------------------------
 
 
-def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
+def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None,
+               overlap: bool = True, compiler_options=None):
     """A TrainStep-compatible step where XLA's SPMD partitioner handles the
     collectives: parameters/optimizer state carry NamedShardings from the
     plan, the batch shards over the data axes, and the loss is the global
     mean — no explicit collective prims, no shard_map.
+
+    ``overlap=True`` (default) compiles the step with the latency-hiding
+    scheduler + async-collective options (parallel/overlap.py), the ROADMAP
+    #5a lever against exposed grad-sync time; ``compiler_options`` merges
+    extra per-executable XLA options on top. The requested config rides the
+    AOT step key, so flipping it misses the executable cache instead of
+    silently reusing a non-overlapped program.
 
     A ``StepGuard`` works here without any explicit psum: the program is ONE
     global computation, so ``isfinite`` of the global loss/grad-norm IS the
     all-host verdict — the partitioner replicates the scalar decision to
     every device, and the ``where`` gate applies it to every shard."""
     from ..training import TrainStep, _batch_pspec
+    from .overlap import resolve_overlap_options
 
     step = TrainStep(tmodule, optimizer, donate=donate, guard=guard)
+    # resolved ONCE at construction: _aot_key consults _overlap_key before
+    # _build ever runs (the AOT load path), so it cannot live in _build
+    overlap_opts, overlap_key = resolve_overlap_options(overlap, compiler_options)
+    step._overlap_key = overlap_key
     if guard is not None:
         guard.mark_distributed()
     if getattr(step.tmodule, "_dist_plan", None) is not None:
@@ -157,12 +209,21 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
             vag = TrainStep._make_vag(self, sync_loss=True)
             self._vag = vag
 
+            from ..observability import runtime as _obs_runtime
+
             def raw_step(tparams, frozen, opt_state, args, kwargs):
                 from ..optim import global_norm as _global_norm
 
-                loss, grads = vag(tparams, frozen, args, kwargs)
+                # named phases, mirroring TrainStep._build: gspmd-road
+                # whole-program profiles join device slices through these
+                # scopes (and the jit_tt_train_step module name below) —
+                # without them the region registry never matches and the
+                # window reports attributed_frac 0.0 (ISSUE 19 satellite)
+                with _obs_runtime.fusion_scope("tt_fwd_bwd"):
+                    loss, grads = vag(tparams, frozen, args, kwargs)
                 param_grads = grads[0][0]
-                new_params, new_state = optimizer.update(tparams, param_grads, opt_state)
+                with _obs_runtime.fusion_scope("tt_optimizer"):
+                    new_params, new_state = optimizer.update(tparams, param_grads, opt_state)
                 if vag.consume_pending_effects():
                     raise NotImplementedError(
                         "buffer mutations (BatchNorm running stats) are not "
@@ -184,6 +245,19 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
                     lambda nw, od: jnp.where(finite, nw, od), new_state, opt_state)
                 return loss, new_params, new_state, (), (finite, gnorm)
 
+            # level-0/1/2 attribution fallback for the gspmd road: the jitted
+            # program's HLO module becomes jit_tt_train_step (the join that
+            # works on backends whose per-op events drop scope metadata), and
+            # the phase scopes register one level finer — mirroring
+            # TrainStep._build so profiler.attribute() reports honest
+            # attribution instead of 100% unattributed
+            from ..observability import profiler as _obs_profiler
+
+            raw_step.__name__ = "tt_train_step"
+            _obs_profiler.register_region("tt_fwd_bwd", executor="gspmd", level=1)
+            _obs_profiler.register_region("tt_optimizer", executor="gspmd", level=1)
+            _obs_profiler.register_region("tt_train_step", executor="gspmd", level=2)
+
             mesh = plan.mesh
             all_params = dict(self.tmodule.get_parameters())
             trainable = {k: p.data for k, p in all_params.items() if getattr(p, "requires_grad", True)}
@@ -203,8 +277,7 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
             if guard is not None:
                 out_shardings = out_shardings + (
                     (NamedSharding(mesh, P()), NamedSharding(mesh, P())),)
-            jitted = jax.jit(
-                raw_step,
+            jit_kwargs = dict(
                 in_shardings=(pshard, fshard, oshard, bshard_args, bshard_kwargs),
                 # pin outputs so updated params keep their declared layout
                 # (otherwise XLA may pick a different sharding and the next
@@ -212,6 +285,18 @@ def gspmd_step(tmodule, optimizer, plan, *, donate: bool = True, guard=None):
                 out_shardings=out_shardings,
                 donate_argnums=(0, 2) if self.donate else (),
             )
+            if overlap_opts:
+                # latency-hiding scheduler + async collectives, validated by
+                # the per-backend probe in resolve_overlap_options — the
+                # ROADMAP #5a lever on the compiler-partitioned road
+                jit_kwargs["compiler_options"] = dict(overlap_opts)
+            try:
+                jitted = jax.jit(raw_step, **jit_kwargs)
+            except TypeError:
+                # jax without the compiler_options jit kwarg: drop the
+                # options (overlap becomes best-effort) rather than fail
+                jit_kwargs.pop("compiler_options", None)
+                jitted = jax.jit(raw_step, **jit_kwargs)
 
             ctx_mesh = _auto_mesh(mesh)
             # use_mesh (new) -> set_mesh (mid) -> the Mesh object itself as

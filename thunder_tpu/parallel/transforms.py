@@ -118,6 +118,15 @@ class DistributedTransform(Transform):
     def __init__(self, plan: DistPlan):
         self.plan = plan
 
+    def __repr__(self):
+        # deterministic (no object address) so _safe_repr-derived cache keys
+        # are stable across processes; the plan's axis/strategy sets identify
+        # the transform's effect on the traced program
+        strat = ",".join(f"{k}:{'+'.join(s.kind + '@' + s.axis for s in v)}"
+                         for k, v in sorted(self.plan.param_strategies.items()))
+        return (f"{type(self).__name__}(axes={tuple(self.plan.mesh.axis_names)}, "
+                f"data={tuple(self.plan.data_axes)}, {strat})")
+
 
 class DDPTransform(DistributedTransform):
     """Reference thunder/distributed/transforms/ddp_v2.py:25."""
@@ -155,10 +164,20 @@ def _place_params(tmodule: ThunderModule, plan: DistPlan) -> None:
             pass  # single-device fallback: leave placement to jit
 
 
-def ddp(tmodule: ThunderModule, mesh: Mesh, *, axis: str = DP_AXIS) -> ThunderModule:
+def ddp(tmodule: ThunderModule, mesh: Mesh, *, axis: str = DP_AXIS,
+        bucket_mb: Optional[float] = None) -> ThunderModule:
     """Replicated data parallel (reference thunder.distributed.ddp,
     thunder/distributed/__init__.py:203): params replicated over `axis`,
-    batch sharded, grads all-reduced (pre-averaged via the loss pmean)."""
+    batch sharded, grads all-reduced (pre-averaged via the loss pmean).
+
+    ``bucket_mb`` buckets the per-param grad all-reduces (reference
+    bucket_size_in_mb): N small same-axis reduces in the backward trace
+    become pack -> one all_reduce -> unpack at the LAST member's site, so
+    early layers' grad sync launches while the remaining backward still
+    computes — the explicit road's comms-overlap lever (ROADMAP #5a). The
+    rewrite is bit-identical to the unbucketed program (pack/unpack is pure
+    data movement around the same reduction; tests/test_mfu_levers.py holds
+    this as an exact equality)."""
     plan = _get_plan(tmodule) or DistPlan(mesh)
     new = DistPlan(mesh, {}, (axis,))
     for name, p in tmodule.get_parameters().items():
@@ -167,6 +186,10 @@ def ddp(tmodule: ThunderModule, mesh: Mesh, *, axis: str = DP_AXIS) -> ThunderMo
     _set_plan(tmodule, plan)
     _place_params(tmodule, plan)
     tmodule._cfn._transforms.append(DDPTransform(plan))
+    if bucket_mb is not None:
+        from .bucketing import GradBucketingTransform
+
+        tmodule._cfn._transforms.append(GradBucketingTransform(bucket_mb))
     return tmodule
 
 

@@ -61,6 +61,27 @@ def _annotated(cfn, name: str):
     return dispatch
 
 
+def quantize_for_serving(gpt, mode: Optional[str]):
+    """Apply weight-only quantization to a GPT before its paged programs are
+    traced. ``mode``: None/``"none"`` is a no-op; ``"int8"`` swaps every
+    Linear's weights for symmetric per-output-channel int8 + f32 scales
+    (transforms/quantization.py), so the packed decode step's matmuls run
+    int8 x bf16 with the dequant in-register — the Pallas int8_linear kernel
+    on TPU (executors/pallasex.py; weights stay int8-resident in HBM, which
+    is the decode-bandwidth win), XLA's dequant-matmul elsewhere.
+
+    Must run BEFORE PagedGPTRunner traces the programs and before the engine
+    snapshots ``named_parameters`` — both see the quantized module."""
+    if mode in (None, "none"):
+        return gpt
+    if mode != "int8":
+        raise ValueError(f"unknown serving quantization mode: {mode!r}")
+    from ..transforms.quantization import QuantizeInt8Transform
+
+    QuantizeInt8Transform().transform_module(gpt)
+    return gpt
+
+
 def bucket_len(n: int, *, minimum: int, maximum: int) -> int:
     """Next power-of-two >= n, floored at `minimum` (>= page_size so every
     bucket is page-aligned) and capped at `maximum` (= max_seq).

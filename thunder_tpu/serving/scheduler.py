@@ -72,7 +72,7 @@ from ..observability import telemetry as _obs_tel
 from ..observability import tracing as _obs_trace
 from ..observability.slo import SLOMonitor, SLOPolicy
 from .kv_pages import PagedKVCache, PrefixCache
-from .runner import PagedGPTRunner
+from .runner import PagedGPTRunner, quantize_for_serving
 
 _NULL = contextlib.nullcontext()
 
@@ -159,6 +159,9 @@ class ServingEngine:
                     draft, 0 without)
     preemption      allow spilling batch-lane sequences for interactive
                     admission / SLO burn (on; only bites with lanes in use)
+    quantize        weight-only quantization applied before tracing:
+                    None/"none" or "int8" (int8 x bf16 decode compute via
+                    the Pallas dequant-in-kernel linear on TPU)
     """
 
     def __init__(self, gpt, *, max_batch: int = 8, page_size: int = 16,
@@ -167,7 +170,11 @@ class ServingEngine:
                  slo: Optional[SLOPolicy] = None, prefix_sharing: bool = False,
                  chunk_tokens: Optional[int] = None,
                  prefill_budget: Optional[int] = None, draft_gpt=None,
-                 spec_k: Optional[int] = None, preemption: bool = True):
+                 spec_k: Optional[int] = None, preemption: bool = True,
+                 quantize: Optional[str] = None):
+        # weight-only quantization must precede BOTH the program tracing and
+        # the named_parameters snapshot below (runner.quantize_for_serving)
+        gpt = quantize_for_serving(gpt, quantize)
         cfg = gpt.cfg
         self.gpt = gpt
         self.cfg = cfg

@@ -396,6 +396,11 @@ class TrainStep:
             # identity keys it so a different ladder (different rungs, so
             # different padded shapes could coincide) never shares an entry
             self.buckets.key_fields() if self.buckets is not None else "nobuckets",
+            # overlap compiler options (parallel/overlap.py) change the
+            # compiled executable without changing any input metadata: a
+            # config flip must MISS the cache, never reuse a non-overlapped
+            # program under an overlap-requested step (or vice versa)
+            getattr(self, "_overlap_key", "nooverlap"),
             "|".join(_safe_repr(t) for t in getattr(self.tmodule._cfn, "_transforms", ())),
         ])
         inputs = (tparam_arrays, frozen_arrays, self.opt_state, args, kwargs)

@@ -27,6 +27,7 @@ TPU-first redesign:
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -65,8 +66,28 @@ def _q(x, scale, fmt_max, dtype):
     return jnp.clip(x.astype(jnp.float32) * scale, -fmt_max, fmt_max).astype(dtype)
 
 
+def _use_fused(x, w) -> bool:
+    """Route through the fused Pallas kernel (executors/pallasex.py
+    fp8_linear_fused): quantize + amax + matmul in one VMEM pass, killing
+    the separate memory-bound scaling programs the profiler blamed for the
+    fp8 road's 0.83x-of-bf16 regression. TT_FP8_FUSED=0 disables."""
+    if os.environ.get("TT_FP8_FUSED", "1") == "0":
+        return False
+    try:
+        from ..executors.pallasex import fp8_linear_fused_supported
+    except Exception:
+        return False
+    return fp8_linear_fused_supported(x, w)
+
+
 def _linear_fwd_meta(x, w, bias, hist_x, hist_w, margin=0):
-    return TensorProxy(shape=x.shape[:-1] + (w.shape[0],), dtype=x.dtype, device=x.device)
+    # the operand amaxes come back as extra outputs: the fused kernel
+    # reduces them in the matmul's VMEM pass, and even unfused this lets
+    # the transform's history roll reuse them instead of re-reading x/w
+    y = TensorProxy(shape=x.shape[:-1] + (w.shape[0],), dtype=x.dtype, device=x.device)
+    ax = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
+    aw = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
+    return y, ax, aw
 
 
 def _linear_fwd_impl(state: FP8Recipe, x, w, bias, hist_x, hist_w, margin=0):
@@ -75,13 +96,20 @@ def _linear_fwd_impl(state: FP8Recipe, x, w, bias, hist_x, hist_w, margin=0):
     # one); the executor state carries the default recipe/formats
     sx = _scale_from_hist(hist_x, E4M3_MAX, margin)
     sw = _scale_from_hist(hist_w, E4M3_MAX, margin)
-    xq = _q(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
-    wq = _q(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
-    acc = jnp.matmul(xq, wq.T, preferred_element_type=jnp.float32)
-    y = acc / (sx * sw)
+    if _use_fused(x, w):
+        from ..executors.pallasex import fp8_linear_fused
+
+        y, ax, aw = fp8_linear_fused(x, w, sx, sw, fmt_max=E4M3_MAX)
+    else:
+        xq = _q(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+        wq = _q(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+        acc = jnp.matmul(xq, wq.T, preferred_element_type=jnp.float32)
+        y = acc / (sx * sw)
+        ax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        aw = jnp.max(jnp.abs(w)).astype(jnp.float32)
     if bias is not None:
         y = y + bias
-    return y.astype(x.dtype)
+    return y.astype(x.dtype), ax, aw
 
 
 def _aug_fwd_meta(x, w, bias, hist_x, hist_w, margin=0):
@@ -92,19 +120,29 @@ def _aug_fwd_meta(x, w, bias, hist_x, hist_w, margin=0):
     # in the trace (sx and sw would collapse to one value)
     sx = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
     sw = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
-    return y, xq, wq, sx, sw
+    ax = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
+    aw = TensorProxy(shape=(), dtype=dtypes.float32, device=x.device)
+    return y, xq, wq, sx, sw, ax, aw
 
 
 def _aug_fwd_impl(state: FP8Recipe, x, w, bias, hist_x, hist_w, margin=0):
     sx = _scale_from_hist(hist_x, E4M3_MAX, margin)
     sw = _scale_from_hist(hist_w, E4M3_MAX, margin)
-    xq = _q(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
-    wq = _q(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
-    acc = jnp.matmul(xq, wq.T, preferred_element_type=jnp.float32)
-    y = acc / (sx * sw)
+    if _use_fused(x, w):
+        from ..executors.pallasex import fp8_linear_fused
+
+        y, xq, wq, ax, aw = fp8_linear_fused(x, w, sx, sw, fmt_max=E4M3_MAX,
+                                             save_quantized=True)
+    else:
+        xq = _q(x, sx, E4M3_MAX, jnp.float8_e4m3fn)
+        wq = _q(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
+        acc = jnp.matmul(xq, wq.T, preferred_element_type=jnp.float32)
+        y = acc / (sx * sw)
+        ax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        aw = jnp.max(jnp.abs(w)).astype(jnp.float32)
     if bias is not None:
         y = y + bias
-    return y.astype(x.dtype), xq, wq, sx, sw
+    return y.astype(x.dtype), xq, wq, sx, sw, ax, aw
 
 
 def _linear_bwd_meta(xq, wq, sx, sw, has_bias, out_dtype, margin, do):
@@ -158,11 +196,15 @@ def _register_grad_rule():
 
     @register_augmented_forward(fp8_train_linear.id)
     def _fp8_aug(x, w, bias, hist_x, hist_w, margin=0):
-        y, xq, wq, sx, sw = _fp8_aug_fwd(x, w, bias, hist_x, hist_w, margin)
-        return VJPResult(y, (xq, wq, sx, sw, bias is not None, x.dtype, margin))
+        y, xq, wq, sx, sw, ax, aw = _fp8_aug_fwd(x, w, bias, hist_x, hist_w, margin)
+        return VJPResult((y, ax, aw), (xq, wq, sx, sw, bias is not None, x.dtype, margin))
 
     @register_backward(fp8_train_linear.id)
-    def _fp8_bwd_rule(xq, wq, sx, sw, has_bias, out_dtype, margin, g):
+    def _fp8_bwd_rule(xq, wq, sx, sw, has_bias, out_dtype, margin, g,
+                      g_ax=None, g_aw=None):
+        # g_ax/g_aw: cotangents of the amax outputs — they only feed the
+        # (non-differentiated) history-roll buffer effects, so they are
+        # zero/None by construction and intentionally dropped
         outs = _fp8_bwd(xq, wq, sx, sw, has_bias, out_dtype, margin, g)
         if has_bias:
             dx, dw, db = outs
@@ -213,14 +255,15 @@ class FP8TrainingTransform(Transform):
                     b_p = m._parameters.get("bias")
                     shape = x.shape
                     x2 = ltorch.reshape(x, (-1, shape[-1])) if x.ndim != 2 else x
-                    y = fp8_train_linear(x2, w_p, b_p, hx, hw, margin)
+                    y, amax_x, amax_w = fp8_train_linear(x2, w_p, b_p, hx, hw, margin)
                     if x.ndim != 2:
                         y = ltorch.reshape(y, shape[:-1] + (y.shape[-1],))
                     # roll the amax histories (delayed scaling: NEXT step's
                     # scale sees this step's amax) — plain traced ops riding
-                    # the buffer-effect path like BatchNorm running stats
-                    amax_x = ltorch.max(ltorch.abs(x))
-                    amax_w = ltorch.max(ltorch.abs(w_p))
+                    # the buffer-effect path like BatchNorm running stats.
+                    # The amaxes come OUT of the linear symbol (fused into
+                    # the matmul's VMEM pass on TPU) instead of separate
+                    # ltorch.max(abs(...)) passes re-reading x and w.
                     new_hx = ltorch.cat([ltorch.reshape(amax_x, (1,)), hx[:-1]], 0)
                     new_hw = ltorch.cat([ltorch.reshape(amax_w, (1,)), hw[:-1]], 0)
                     m.update_buffer("fp8_amax_x_hist", new_hx)
