@@ -1,0 +1,79 @@
+"""Quickstart: pretrain a Mixtral-style MoE decoder with grouped dispatch.
+
+    python examples/quickstart/moe_pretrain.py [--steps 10] [--dispatch grouped]
+
+Tokens route top-k to SwiGLU experts through capacity-packed bins driving
+``ltorch.grouped_mlp`` (the Pallas grouped kernel claims it on TPU; the
+pure-jax decomposition is the CPU/interpret reference — both roads are
+token-exact equals of the one-hot einsum, flip with --dispatch dense).
+Observability is enabled BEFORE the first step so the traced program carries
+the routing-health buffer refresh; each logged step publishes the ``moe.*``
+gauges (per-expert load, dropped tokens, router entropy) that
+``tools/obs_summary.py`` renders under ``== moe ==``.
+
+(Counterpart of the reference's MoE benchmark path,
+thunder/benchmarks/benchmark_inference.py.)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import argparse
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+import thunder_tpu as tt
+from thunder_tpu import observability, optim
+from thunder_tpu.models.litgpt import Config
+from thunder_tpu.models.moe import MoEConfig, MoEGPT, publish_moe_stats
+from thunder_tpu.training import TrainStep
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--capacity-factor", type=float, default=1.0)
+    p.add_argument("--dispatch", choices=["grouped", "dense"], default="grouped")
+    p.add_argument("--lr", type=float, default=3e-4)
+    args = p.parse_args()
+
+    gpt_cfg = Config.from_name("tiny-llama2", block_size=args.seq)
+    moe_cfg = MoEConfig(n_embd=gpt_cfg.n_embd, intermediate_size=160,
+                        n_expert=args.experts, n_expert_per_token=2,
+                        capacity_factor=args.capacity_factor,
+                        dispatch=args.dispatch)
+    model = MoEGPT(gpt_cfg, moe_cfg)
+
+    observability.enable()  # BEFORE compile: the stat refresh is traced in
+    step = TrainStep(model, optim.AdamW(lr=args.lr))
+
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, gpt_cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(0, gpt_cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
+
+    t0 = time.perf_counter()
+    loss = float(step(idx, tgt))
+    print(f"compile+step0 {time.perf_counter() - t0:.1f}s  loss {loss:.4f}")
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step(idx, tgt)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = args.batch * args.seq * args.steps / dt
+    publish_moe_stats(model)
+    gauges = {k: round(v, 4) for k, v in observability.gauges().items()
+              if k in ("moe.expert_load_max", "moe.router_entropy")}
+    dropped = observability.counters().get("moe.dropped_tokens", 0)
+    print(f"{args.steps} steps: {dt:.2f}s  {tok_s:,.0f} tok/s  final loss {loss:.4f}")
+    print(f"routing health: {gauges}  dropped_tokens {dropped}")
+    observability.disable()
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,16 @@ def pytest_configure(config):
         "bucketed grad-sync bit-identity, fused fp8 kernel parity, int8 "
         "decode token-identity, committed-artifact schema gates; filter "
         "with -m perf / -m 'not perf')")
+    config.addinivalue_line(
+        "markers",
+        "moe: mixture-of-experts test (grouped-dispatch bit-identity, "
+        "capacity/drop semantics, EP×DP mesh wiring, moe.* telemetry; "
+        "filter with -m moe / -m 'not moe')")
+    config.addinivalue_line(
+        "markers",
+        "longctx: long-context test (streaming ring-flash identity, GQA "
+        "ring attention, 32k paged serving; the genuinely long-T runs also "
+        "carry `slow`; filter with -m longctx / -m 'not longctx')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -26,6 +26,21 @@ SCRIPTS = {
 }
 
 
+@pytest.mark.moe
+@pytest.mark.slow  # tier-1 straddles its wall budget; the moe lane runs this
+def test_quickstart_moe_pretrain():
+    """The MoE quickstart trains end-to-end with grouped dispatch and
+    prints routing health from the moe.* gauges."""
+    path = os.path.join(QS, "moe_pretrain.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    out = subprocess.run([sys.executable, path, "--steps", "3"], env=env,
+                         capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, f"moe_pretrain.py failed:\n{out.stderr[-1500:]}"
+    assert "routing health" in out.stdout
+
+
 @pytest.mark.parametrize("script", sorted(SCRIPTS))
 def test_quickstart_runs(script):
     if script == "serving_quantized_nf4":
@@ -85,7 +100,8 @@ def test_quickstart_runs_with_trace_checking(script):
 
 
 @pytest.mark.perf
-@pytest.mark.parametrize("artifact", ["BENCH_MFU.json", "BENCH_FP8.json"])
+@pytest.mark.parametrize("artifact", ["BENCH_MFU.json", "BENCH_FP8.json",
+                                      "BENCH_MOE.json", "BENCH_LONGCTX.json"])
 def test_perf_gate_checks_committed_artifacts(artifact):
     """The committed MFU/fp8 rows stay loadable and gateable: perf_gate
     --check self-compares the artifact (exercising the parse + compare

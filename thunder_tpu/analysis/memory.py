@@ -96,6 +96,33 @@ def paged_chunk_vmem_bytes(page_size: int, D: int, g: int, T: int,
     return paged_decode_vmem_bytes(page_size, D, g * T, kv_itemsize, q_itemsize)
 
 
+def grouped_mlp_vmem_bytes(block_c: int, D: int, H: int,
+                           w_itemsize: int, x_itemsize: int) -> int:
+    """Estimated per-program VMEM working set of the grouped-expert MLP
+    kernel (pallasex `_grouped_mlp_kernel`): one expert's three weight
+    panels, a (block_c, D) token-bin block, the fused f32 SwiGLU
+    intermediates (gate/up/hidden), and the output block."""
+    w = 3 * D * H * w_itemsize              # w_gate + w_up + w_down(T) panels
+    xb = block_c * D * x_itemsize           # input bin block
+    inter = block_c * (3 * H) * 4           # g, u, h in f32
+    out = block_c * D * x_itemsize          # output bin block
+    return w + xb + inter + out
+
+
+def ring_flash_vmem_bytes(block_q: int, T_blk: int, D: int,
+                          q_itemsize: int, kv_itemsize: int) -> int:
+    """Estimated per-program VMEM working set of one streaming ring-flash
+    step (pallasex `_ring_flash_step_kernel`): the resident q block, this
+    ring step's K/V shard (T_blk rows — the per-device block, not the
+    global T), and the carried f32 (o, m, l) accumulator tiles. O(block)
+    in the global sequence length by construction."""
+    qb = block_q * D * q_itemsize
+    kv = 2 * T_blk * D * kv_itemsize
+    acc = block_q * D * 4 + 2 * block_q * 4  # o acc + m/l carries (f32)
+    out = block_q * D * 4
+    return qb + kv + acc + out
+
+
 def flash_block_cap(widest_itemsize: int, block_q: int, block_k: int,
                     T: int, Tk: int) -> tuple[int, int]:
     """Flash-attention block sizes are swept for bf16; 4-byte operands

@@ -1957,3 +1957,575 @@ def _paged_chunk_attention_impl(q, k_pages, v_pages, page_table, q_pos, scale=No
 
 ex.register_implementation("thunder.paged_chunk_attention", _paged_chunk_attention_impl,
                            checker=paged_chunk_attention_supported)
+
+
+# ===========================================================================
+# Grouped-expert MLP (MoE capacity-routed dispatch)
+# ===========================================================================
+#
+# Tokens are packed into per-expert capacity bins (E, cap, D) by the routing
+# scatter; the grid runs (expert, bin-block) so each expert's MXU matmuls
+# touch ONLY its own bin — the dense one-hot einsum road multiplies every
+# token through every expert (O(E*cap*D*H) regardless of routing). Bin rows
+# at/after group_sizes[e] are zero-filled padding: wholly-padding blocks are
+# skipped (zero write, no MXU work), partially-padding blocks compute them
+# anyway — SwiGLU(0) = 0 exactly, so both roads agree bitwise on padding.
+
+_GROUPED_BLOCK_C = int(os.environ.get("TT_GROUPED_BLOCK_C", "128"))
+
+
+def _grouped_mlp_kernel(gs_ref, x_ref, wg_ref, wu_ref, wd_ref, o_ref, *, block_c: int):
+    # grid (E, cap // block_c); x_ref (block_c, D) — one bin block of expert
+    # e; wg/wu (D, H), wd (H, D) — expert e's panels; gs_ref (E,) prefetched
+    e = pl.program_id(0)
+    c = pl.program_id(1)
+    live = c * block_c < gs_ref[e]
+
+    @pl.when(jnp.logical_not(live))
+    def _pad():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    @pl.when(live)
+    def _compute():
+        x = x_ref[:]
+        wd = wd_ref[:]
+        # fused SwiGLU in one VMEM pass: f32 accumulation for the dots,
+        # silu on the VPU, single down-projection write
+        g = jax.lax.dot_general(x, wg_ref[:], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, wu_ref[:], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        h = (g * (1.0 / (1.0 + jnp.exp(-g)))) * u
+        o_ref[:] = jax.lax.dot_general(h.astype(wd.dtype), wd,
+                                       (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def grouped_mlp_fused(bins, w_gate, w_up, w_down, group_sizes, *,
+                      block_c: int | None = None, interpret: bool | None = None):
+    """bins (E, cap, D) x per-expert panels (E, D, H)/(E, H, D) with
+    group_sizes (E,) int32 -> (E, cap, D). Rows past group_sizes[e] must be
+    zero-filled (the dispatch scatter's contract); whole padding blocks skip
+    the MXU entirely."""
+    E, cap, D = bins.shape
+    H = w_gate.shape[-1]
+    if block_c is None:
+        block_c = math.gcd(cap, _GROUPED_BLOCK_C)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(E, cap // block_c),
+        in_specs=[
+            pl.BlockSpec((None, block_c, D), lambda e, c, gs: (e, c, 0)),
+            pl.BlockSpec((None, D, H), lambda e, c, gs: (e, 0, 0)),
+            pl.BlockSpec((None, D, H), lambda e, c, gs: (e, 0, 0)),
+            pl.BlockSpec((None, H, D), lambda e, c, gs: (e, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_c, D), lambda e, c, gs: (e, c, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_grouped_mlp_kernel, block_c=block_c),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((E, cap, D), bins.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(group_sizes.astype(jnp.int32), bins, w_gate, w_up, w_down)
+
+
+def grouped_mlp_supported(bins, w_gate, w_up, w_down, group_sizes) -> bool:
+    """Checker: the grouped kernel claims thunder.grouped_mlp on TPU
+    (TT_GROUPED_KERNEL=1 forces the claim for interpret-mode A/B, =0 never
+    claims); the per-program working set — one expert's three weight panels
+    plus a bin block and its f32 SwiGLU intermediates — must fit the VMEM
+    budget, otherwise the batched-matmul decomposition runs (the ADVICE
+    fallback pattern, unified via analysis/memory.py)."""
+    if pltpu is None:
+        return False
+    override = os.environ.get("TT_GROUPED_KERNEL")
+    if override == "0":
+        return False
+    if not (_on_tpu() or override == "1"):
+        return False
+    if getattr(bins, "ndim", 0) != 3 or getattr(w_gate, "ndim", 0) != 3:
+        return False
+    E, cap, D = bins.shape
+    H = w_gate.shape[-1]
+    shapes_ok = (
+        tuple(w_gate.shape) == (E, D, H)
+        and tuple(w_up.shape) == (E, D, H)
+        and tuple(w_down.shape) == (E, H, D)
+        and getattr(group_sizes, "ndim", 0) == 1 and group_sizes.shape[0] == E
+        and cap % 8 == 0  # sublane tile
+        and D <= 4096 and H <= 16384
+    )
+    if not shapes_ok:
+        return False
+    from ..analysis import budget as _budget
+
+    block_c = math.gcd(cap, _GROUPED_BLOCK_C)
+    w_item = jnp.dtype(str(w_gate.dtype).rpartition(".")[2]).itemsize
+    x_item = jnp.dtype(str(bins.dtype).rpartition(".")[2]).itemsize
+    return _budget.within_vmem(
+        _budget.grouped_mlp_vmem_bytes(block_c, D, H, w_item, x_item))
+
+
+_grouped_mlp_claimed = _jit_claimed(
+    lambda bins, w_gate, w_up, w_down, group_sizes: grouped_mlp_fused(
+        bins, w_gate, w_up, w_down, group_sizes),
+    (), lambda *a: (a, {}))
+
+
+ex.register_implementation("thunder.grouped_mlp", _grouped_mlp_claimed,
+                           checker=grouped_mlp_supported)
+
+
+def _register_grouped_mlp_grad_rule():
+    """Executor-claimed grad for thunder.grouped_mlp: the fused kernel runs
+    the forward; the backward is the straight SwiGLU chain rule over the
+    SAME capacity bins (padding rows are zero, so their contributions to
+    every weight grad vanish identically). Falls through to the composite
+    decomposition when the kernel can't claim the shapes."""
+    from ..transforms.autodiff import VJPResult, register_augmented_forward, register_backward
+
+    def fwd_meta(bins, w_gate, w_up, w_down, group_sizes):
+        return TensorProxy(shape=bins.shape, dtype=bins.dtype, device=bins.device)
+
+    fwd_sym = Symbol("grouped_mlp_fwd", fwd_meta, id="pallas.grouped_mlp_fwd",
+                     is_prim=True, module="pallas", executor=ex)
+    ex.opmap[fwd_sym.id] = lambda bins, w_gate, w_up, w_down, group_sizes: (
+        grouped_mlp_fused(bins, w_gate, w_up, w_down, group_sizes))
+
+    def bwd_meta(bins, w_gate, w_up, w_down, group_sizes, do):
+        return (TensorProxy(shape=bins.shape, dtype=bins.dtype, device=bins.device),
+                TensorProxy(shape=w_gate.shape, dtype=w_gate.dtype, device=w_gate.device),
+                TensorProxy(shape=w_up.shape, dtype=w_up.dtype, device=w_up.device),
+                TensorProxy(shape=w_down.shape, dtype=w_down.dtype, device=w_down.device))
+
+    def bwd_impl(bins, w_gate, w_up, w_down, group_sizes, do):
+        g = jnp.einsum("ecd,edh->ech", bins, w_gate)
+        u = jnp.einsum("ecd,edh->ech", bins, w_up)
+        sg = jax.nn.sigmoid(g)
+        h = g * sg * u
+        dh = jnp.einsum("ecd,ehd->ech", do, w_down)
+        dwd = jnp.einsum("ech,ecd->ehd", h, do)
+        du = dh * (g * sg)
+        dg = dh * u * (sg * (1.0 + g * (1.0 - sg)))
+        dbins = (jnp.einsum("ech,edh->ecd", dg, w_gate)
+                 + jnp.einsum("ech,edh->ecd", du, w_up))
+        dwg = jnp.einsum("ecd,ech->edh", bins, dg)
+        dwu = jnp.einsum("ecd,ech->edh", bins, du)
+        return (dbins.astype(bins.dtype), dwg.astype(w_gate.dtype),
+                dwu.astype(w_up.dtype), dwd.astype(w_down.dtype))
+
+    bwd_sym = Symbol("grouped_mlp_bwd", bwd_meta, id="pallas.grouped_mlp_bwd",
+                     is_prim=True, module="pallas", executor=ex)
+    ex.opmap[bwd_sym.id] = bwd_impl
+
+    @register_augmented_forward("thunder.grouped_mlp")
+    def _grouped_mlp_aug(bins, w_gate, w_up, w_down, group_sizes):
+        if not grouped_mlp_supported(bins, w_gate, w_up, w_down, group_sizes):
+            return NotImplemented  # decompose: batched-matmul grad rules apply
+        out = fwd_sym(bins, w_gate, w_up, w_down, group_sizes)
+        return VJPResult(out, (bins, w_gate, w_up, w_down, group_sizes))
+
+    @register_backward("thunder.grouped_mlp")
+    def _grouped_mlp_bwd(bins, w_gate, w_up, w_down, group_sizes, do):
+        dbins, dwg, dwu, dwd = bwd_sym(bins, w_gate, w_up, w_down, group_sizes, do)
+        return dbins, dwg, dwu, dwd, None
+
+
+_register_grouped_mlp_grad_rule()
+
+
+# ===========================================================================
+# Streaming ring-flash attention (context parallelism)
+# ===========================================================================
+#
+# One ring step = one pallas_call: the ppermute'd K/V shard (T_blk rows, the
+# per-device block — not the global sequence) is consumed by the flash
+# online-softmax body with the (o, m, l) accumulators carried in HBM between
+# steps, so the VMEM working set is O(block) however long the global context
+# grows. GQA is native — the k/v BlockSpecs index kv head = q head // group,
+# never materializing replicated heads. The causal mask uses GLOBAL
+# positions (q_off/k_off ride as scalar prefetch): each device's q shard
+# starts at my*T, each arriving k shard at src*T.
+#
+# Step-order contract: the jax-level ring MUST process src == my first (the
+# diagonal block). Its first k sub-block gives every causal row at least one
+# valid key, making the carried running max finite before any later
+# fully-masked tile — NEG_INF is a finite sentinel, so a fully-masked tile
+# against a still-NEG_INF max would contribute exp2(0) garbage.
+
+
+def _ring_flash_step_kernel(off_ref, q_ref, k_ref, v_ref, oi_ref, mi_ref, li_ref,
+                            oo_ref, mo_ref, lo_ref, *, block_k: int, causal: bool,
+                            scale: float):
+    # grid (B, H, T // block_q); q_ref (block_q, D); k_ref/v_ref (T_blk, D)
+    # — this ring step's shard; (oi, mi, li) carried accumulators in, the
+    # updated (oo, mo, lo) out. m/l ride in log2 units (flash convention).
+    block_q, D = q_ref.shape
+    Tk = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = q_ref[:]
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+    scale2 = scale * LOG2E
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        o_acc, m, l = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale2
+        if causal:
+            k_pos = k_off + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        o_new = o_acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    n_k = Tk // block_k
+    if causal:
+        # global causal skip: k sub-blocks starting past this q block's last
+        # position contribute nothing (a whole future shard skips entirely)
+        lim = (q_off - k_off) + (qi + 1) * block_q
+        n_k = jnp.clip((lim + block_k - 1) // block_k, 0, n_k)
+    o, m, l = jax.lax.fori_loop(
+        0, n_k, body, (oi_ref[:], mi_ref[:][:, 0], li_ref[:][:, 0]))
+    oo_ref[:] = o
+    mo_ref[:] = m[:, None]
+    lo_ref[:] = l[:, None]
+
+
+def ring_flash_step(q, kb, vb, o, m, l, q_off, k_off, *, causal: bool,
+                    scale: float, block_q: int, block_k: int,
+                    interpret: bool | None = None):
+    """One ring step: fold the arriving K/V shard kb/vb (B, Hkv, T_blk, D)
+    into the carried accumulators o (B, H, T, D) f32 / m, l (B, H, T, 1)
+    f32 for local queries q (B, H, T, D). q_off/k_off are the shards'
+    global sequence offsets (traced: my*T and src*T)."""
+    B, H, T, D = q.shape
+    Tk = kb.shape[2]
+    g = H // kb.shape[1]
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i, off: (b, h, i, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i, off: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, Tk, D), lambda b, h, i, off: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i, off: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i, off: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ring_flash_step_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        interpret=_interpret() if interpret is None else interpret,
+    )(offs, q, kb, vb, o, m, l)
+
+
+def _ring_flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                              delta_ref, dq_ref, *, block_k: int, causal: bool,
+                              scale: float):
+    # the flash dq recompute (see _flash_bwd_dq_kernel) with GLOBAL causal
+    # positions; lse is the GLOBAL log-sum-exp (all ring steps), so p for
+    # this shard's keys is exact and dq contributions just add across steps
+    block_q, D = q_ref.shape
+    Tk = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = q_ref[:]
+    do = do_ref[:]
+    lse2 = lse_ref[:][:, 0] * LOG2E
+    delta = delta_ref[:][:, 0]
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq_acc):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * (scale * LOG2E)
+        if causal:
+            k_pos = k_off + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp2(s - lse2[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                            (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+
+    n_k = Tk // block_k
+    if causal:
+        lim = (q_off - k_off) + (qi + 1) * block_q
+        n_k = jnp.clip((lim + block_k - 1) // block_k, 0, n_k)
+    dq = jax.lax.fori_loop(0, n_k, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[:] = dq
+
+
+def _ring_flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                               delta_ref, dk_ref, dv_ref, *, block_q: int,
+                               causal: bool, scale: float):
+    # transposed orientation (rows = k positions) per-q-head partials,
+    # group-summed outside (the flash GQA backward convention here); global
+    # positions via the off prefetch
+    block_k, D = k_ref.shape
+    Tq = q_ref.shape[0]
+    ki = pl.program_id(2)
+    k_blk = k_ref[:]
+    v_blk = v_ref[:]
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+    k_pos_t = k_off + ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+
+    def body(i, carry):
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse2 = lse_ref[pl.ds(i * block_q, block_q), :][:, 0] * LOG2E
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, 0]
+        q_pos_t = q_off + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        return _dkv_tile(k_blk, v_blk, q, do, lse2, delta, k_pos_t, q_pos_t,
+                         causal, scale, *carry)
+
+    z = jnp.zeros((block_k, D), jnp.float32)
+    n_i = Tq // block_q
+    if causal:
+        # first q tile whose last position reaches this k block
+        i0 = jnp.clip((k_off + ki * block_k - q_off) // block_q, 0, n_i)
+    else:
+        i0 = 0
+    dk, dv = jax.lax.fori_loop(i0, n_i, body, (z, z))
+    dk_ref[:] = dk
+    dv_ref[:] = dv
+
+
+def ring_flash_bwd_step(q, kb, vb, do, lse, delta, q_off, k_off, *, causal: bool,
+                        scale: float, block_q: int, block_k: int,
+                        interpret: bool | None = None):
+    """One backward ring step: local queries against the arriving shard.
+    Returns (dq_contrib (B, H, T, D) f32, dk_contrib/dv_contrib
+    (B, Hkv, T_blk, D) f32) — the kv grads are per-q-head partials
+    group-summed here before the accumulators ride the ring onward."""
+    B, H, T, D = q.shape
+    Hkv, Tk = kb.shape[1], kb.shape[2]
+    g = H // Hkv
+    itp = _interpret() if interpret is None else interpret
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)])
+    dq = pl.pallas_call(
+        functools.partial(_ring_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, T // block_q),
+            in_specs=[
+                pl.BlockSpec((None, None, block_q, D), lambda b, h, i, off: (b, h, i, 0)),
+                pl.BlockSpec((None, None, Tk, D), lambda b, h, i, off: (b, h // g, 0, 0)),
+                pl.BlockSpec((None, None, Tk, D), lambda b, h, i, off: (b, h // g, 0, 0)),
+                pl.BlockSpec((None, None, block_q, D), lambda b, h, i, off: (b, h, i, 0)),
+                pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+                pl.BlockSpec((None, None, block_q, 1), lambda b, h, i, off: (b, h, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, None, block_q, D),
+                                   lambda b, h, i, off: (b, h, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), jnp.float32),
+        interpret=itp,
+    )(offs, q, kb, vb, do, lse, delta)
+
+    dk_p, dv_p = pl.pallas_call(
+        functools.partial(_ring_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Tk // block_k),
+            in_specs=[
+                pl.BlockSpec((None, None, T, D), lambda b, h, j, off: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j, off: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j, off: (b, h // g, j, 0)),
+                pl.BlockSpec((None, None, T, D), lambda b, h, j, off: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j, off: (b, h, 0, 0)),
+                pl.BlockSpec((None, None, T, 1), lambda b, h, j, off: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j, off: (b, h, j, 0)),
+                pl.BlockSpec((None, None, block_k, D), lambda b, h, j, off: (b, h, j, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tk, D), jnp.float32),
+        ],
+        interpret=itp,
+    )(offs, q, kb, vb, do, lse, delta)
+    dk = dk_p.reshape(B, Hkv, g, Tk, D).sum(axis=2)
+    dv = dv_p.reshape(B, Hkv, g, Tk, D).sum(axis=2)
+    return dq, dk, dv
+
+
+def ring_flash_supported(q, k, v) -> bool:
+    """Checker for the streaming ring path inside dist.ring_attention: TPU
+    (TT_RING_KERNEL=1 forces for interpret-mode A/B, =0 never), equal-size
+    shards on the flash tiling, and one step's working set — q block + this
+    shard's K/V + the f32 carries — within the VMEM budget via the unified
+    analysis/memory.py estimate; otherwise the pure-jax GQA-native
+    reference ring runs."""
+    if pltpu is None:
+        return False
+    override = os.environ.get("TT_RING_KERNEL")
+    if override == "0":
+        return False
+    if not (_on_tpu() or override == "1"):
+        return False
+    if getattr(q, "ndim", 0) != 4 or getattr(k, "ndim", 0) != 4:
+        return False
+    B, H, T, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    block_q = min(DEFAULT_BLOCK_Q, T)
+    block_k = min(DEFAULT_BLOCK_K, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk, k, v)
+    shapes_ok = (
+        D <= 512
+        and tuple(v.shape) == tuple(k.shape)
+        and k.shape[0] == B
+        and T == Tk  # equal shards: every device holds T/n rows
+        and H % Hkv == 0
+        and T % block_q == 0 and Tk % block_k == 0
+        and T % 8 == 0
+    )
+    if not shapes_ok:
+        return False
+    from ..analysis import budget as _budget
+
+    q_item = jnp.dtype(str(q.dtype).rpartition(".")[2]).itemsize
+    kv_item = jnp.dtype(str(k.dtype).rpartition(".")[2]).itemsize
+    return _budget.within_vmem(
+        _budget.ring_flash_vmem_bytes(block_q, Tk, D, q_item, kv_item))
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, block_q, block_k,
+                         interpret):
+    B, H, T, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T, 1), jnp.float32)
+
+    def step(carry, i):
+        o, m, l, kb, vb = carry
+        src = jax.lax.rem(my - i + n, n)  # device that produced this shard
+        o, m, l = ring_flash_step(q, kb, vb, o, m, l, my * T, src * T,
+                                  causal=causal, scale=scale, block_q=block_q,
+                                  block_k=block_k, interpret=interpret)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (o, m, l, kb, vb), None
+
+    # i=0 is src == my: the diagonal step that seeds finite running maxima
+    # (see the step-order contract above); after n permutes k/v are home
+    # again, which is what lets the backward reuse the SAME residency
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    l1 = l[..., 0]
+    l_safe = jnp.where(l1 == 0.0, 1.0, l1)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    lse = (m[..., 0] + jnp.log2(l_safe)) * LN2  # (B, H, T), natural log
+    return out, lse
+
+
+def _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal, scale,
+                         block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # (B, H, T, 1)
+    lse1 = lse[..., None].astype(jnp.float32)
+    dq0 = jnp.zeros((B, H, T, D), jnp.float32)
+    dkv0 = jnp.zeros((B, Hkv, T, D), jnp.float32)
+
+    def step(carry, i):
+        dq, kb, vb, dkb, dvb = carry
+        src = jax.lax.rem(my - i + n, n)
+        dq_c, dk_c, dv_c = ring_flash_bwd_step(
+            q, kb, vb, do, lse1, delta, my * T, src * T, causal=causal,
+            scale=scale, block_q=block_q, block_k=block_k, interpret=interpret)
+        dq = dq + dq_c
+        # the kv-grad accumulators travel WITH their shard: after n permutes
+        # both are home with every device's contribution folded in
+        dkb = dkb + dk_c
+        dvb = dvb + dv_c
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        dkb = jax.lax.ppermute(dkb, axis_name, perm)
+        dvb = jax.lax.ppermute(dvb, axis_name, perm)
+        return (dq, kb, vb, dkb, dvb), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq0, k, v, dkv0, dkv0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_flash_vjp(axis_name, causal, scale, block_q, block_k, interpret):
+    @jax.custom_vjp
+    def f(q, k, v):
+        out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                      block_q, block_k, interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                        block_q, block_k, interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _ring_flash_bwd_impl(q, k, v, out, lse, do, axis_name, causal,
+                                    scale, block_q, block_k, interpret)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                         scale=None, interpret: bool | None = None):
+    """Streaming ring attention over the named mesh axis: q (B, H, T, D)
+    local shard, k/v (B, Hkv, T, D) — GQA-native. Differentiable (custom
+    VJP rides the flash backward recompute around the same ring), so
+    jax.vjp — and thus the executor's JAX_VJP_FALLBACK — works through it."""
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(DEFAULT_BLOCK_Q, T)
+    block_k = min(DEFAULT_BLOCK_K, Tk)
+    block_q, block_k = _cap_blocks_for_dtype(q, block_q, block_k, T, Tk, k, v)
+    itp = _interpret() if interpret is None else bool(interpret)
+    return _ring_flash_vjp(str(axis_name), bool(causal), scale,
+                           int(block_q), int(block_k), itp)(q, k, v)

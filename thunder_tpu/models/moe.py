@@ -26,16 +26,25 @@ class MoEConfig:
     intermediate_size: int = 256
     n_expert: int = 8
     n_expert_per_token: int = 2
-    capacity_factor: float = 1.25
+    # None = drop-free (capacity N: the worst case of every token routing one
+    # of its k choices to the same expert); a float opts into Switch-style
+    # drops with cap = ceil(cf * N * K / E) rounded up to the sublane tile
+    capacity_factor: float | None = None
+    # "grouped" packs tokens into per-expert capacity bins and runs
+    # ltorch.grouped_mlp (the pallas grouped kernel claims it on TPU);
+    # "dense" is the one-hot einsum reference road — every expert multiplies
+    # every token, routing handled by combine weights. Both roads share the
+    # router and the capacity/drop decision and are token-exact equals.
+    dispatch: str = "grouped"
 
 
 class MoEMLP(nn.Module):
     """Top-k routed SwiGLU experts with capacity-based static-shape dispatch.
 
-    Tokens are routed to top-k experts; each expert processes a fixed-capacity
-    slice (tokens over capacity are dropped, standard Switch/Mixtral-style).
-    Compute path: one-hot combine weights -> take -> per-expert batched
-    matmuls via a single (E, cap, d) einsum-style batched matmul on the MXU.
+    Tokens are routed to top-k experts; slots are granted FIFO by token index
+    (Switch convention) and tokens over an expert's capacity are dropped —
+    their combine weight is zeroed on the dense road and they never enter a
+    bin on the grouped road, so both roads produce bit-identical outputs.
     """
 
     def __init__(self, cfg: MoEConfig, dtype=jnp.float32):
@@ -48,8 +57,23 @@ class MoEMLP(nn.Module):
         self.w_gate = nn.Parameter(jax.random.uniform(k, (e, d, h), dtype, -s, s))
         self.w_up = nn.Parameter(jax.random.uniform(jax.random.fold_in(k, 1), (e, d, h), dtype, -s, s))
         self.w_down = nn.Parameter(jax.random.uniform(jax.random.fold_in(k, 2), (e, h, d), dtype, -s / 2, s / 2))
+        # routing health stats, refreshed per step only while observability
+        # is enabled (events.enabled() is a trace-time gate: disabled runs
+        # trace zero extra ops) — read back via moe.* telemetry publishers
+        self.register_buffer("moe_expert_load", jnp.zeros((e,), dtype))
+        self.register_buffer("moe_dropped_tokens", jnp.zeros((), dtype))
+        self.register_buffer("moe_router_entropy", jnp.zeros((), dtype))
+
+    def capacity(self, n_tokens: int) -> int:
+        cfg = self.cfg
+        if cfg.capacity_factor is None:
+            return n_tokens  # drop-free: an expert appears at most once per token
+        cap = math.ceil(cfg.capacity_factor * n_tokens * cfg.n_expert_per_token / cfg.n_expert)
+        return min(n_tokens, (cap + 7) // 8 * 8)  # sublane-tile rounding
 
     def forward(self, x):
+        from ..observability import events
+
         cfg = self.cfg
         B, T, D = x.shape
         N = B * T
@@ -62,22 +86,77 @@ class MoEMLP(nn.Module):
         # normalize selected probabilities (Mixtral convention)
         topk_probs = topk_probs / ltorch.sum(topk_probs, -1, keepdim=True)
 
-        # dense dispatch: for each expert, weight of each token for that expert
-        # (N, K, E) one-hot -> (N, E) combine weights; static shapes throughout
-        idx_oh = ltorch.one_hot(topk_idx, E)  # (N, K, E) int
-        combine = ltorch.sum(idx_oh.to(probs.dtype) * ltorch.unsqueeze(topk_probs, -1), 1)  # (N, E)
+        # capacity/drop decision shared by BOTH roads: slot rank within each
+        # expert is FIFO by flattened (token, k) index via cumsum of one-hot
+        cap = self.capacity(N)
+        flat_e = ltorch.reshape(topk_idx, (N * K,))
+        oh = ltorch.one_hot(flat_e, E)  # (N*K, E) int
+        ranks = ltorch.cumsum(oh, 0)
+        rank = ltorch.squeeze(ltorch.take_along_dim(ranks, ltorch.unsqueeze(flat_e, 1), 1), 1) - 1
+        keep = rank < cap  # (N*K,) bool
+        counts = ltorch.sum(oh, 0)  # (E,) assignments per expert
+        w = ltorch.reshape(topk_probs, (N * K,)) * keep.to(probs.dtype)
 
-        # every expert sees all tokens masked by routing weight — dense-MoE
-        # formulation: einsum over experts maps to E batched MXU matmuls.
-        # (E, N, D) x (E, D, H) -> (E, N, H)
-        xe = ltorch.expand(ltorch.unsqueeze(xf, 0), (E, N, D))
-        g = ltorch.matmul(xe, self.w_gate)
-        u = ltorch.matmul(xe, self.w_up)
-        h = ltorch.silu(g) * u
-        out_e = ltorch.matmul(h, self.w_down)  # (E, N, D)
-        combine_t = ltorch.permute(combine, (1, 0))  # (E, N)
-        out = ltorch.sum(out_e * ltorch.unsqueeze(combine_t, -1), 0)  # (N, D)
+        if events.enabled():
+            lsm = ltorch.log_softmax(router_logits, -1)
+            entropy = -ltorch.sum(ltorch.sum(probs * lsm, -1), 0) / N
+            self.update_buffer("moe_expert_load", counts.to(probs.dtype) / (N * K))
+            self.update_buffer("moe_dropped_tokens",
+                               (N * K) - ltorch.sum(keep.to(probs.dtype), 0))
+            self.update_buffer("moe_router_entropy", entropy)
+
+        if cfg.dispatch == "dense":
+            # one-hot einsum reference: every expert multiplies every token,
+            # dropped (token, k) pairs contribute an exact 0 via their weight
+            comb = oh.to(probs.dtype) * ltorch.unsqueeze(w, 1)  # (N*K, E)
+            combine = ltorch.sum(ltorch.reshape(comb, (N, K, E)), 1)  # (N, E)
+            xe = ltorch.expand(ltorch.unsqueeze(xf, 0), (E, N, D))
+            g = ltorch.matmul(xe, self.w_gate)
+            u = ltorch.matmul(xe, self.w_up)
+            h = ltorch.silu(g) * u
+            out_e = ltorch.matmul(h, self.w_down)  # (E, N, D)
+            combine_t = ltorch.permute(combine, (1, 0))  # (E, N)
+            out = ltorch.sum(out_e * ltorch.unsqueeze(combine_t, -1), 0)  # (N, D)
+            return ltorch.reshape(out, (B, T, D))
+
+        # grouped road: scatter kept tokens into per-expert capacity bins
+        # (dropped tokens land on a trash row sliced off before the matmuls),
+        # run the grouped MLP over (E, cap, D), gather back by slot
+        trash = E * cap
+        slot = ltorch.where(keep, flat_e * cap + rank, trash)  # (N*K,)
+        xk = ltorch.reshape(ltorch.expand(ltorch.unsqueeze(xf, 1), (N, K, D)), (N * K, D))
+        idx = ltorch.expand(ltorch.unsqueeze(slot, 1), (N * K, D))
+        zero_bins = ltorch.full((trash + 1, D), 0.0, dtype=x.dtype, device=x.device)
+        bins_flat = ltorch.scatter_add(zero_bins, 0, idx, xk)
+        bins = ltorch.reshape(bins_flat[:trash], (E, cap, D))
+        group_sizes = ltorch.clamp(counts, max=cap)
+        y = ltorch.grouped_mlp(bins, self.w_gate, self.w_up, self.w_down, group_sizes)
+        zero_row = ltorch.full((1, D), 0.0, dtype=x.dtype, device=x.device)
+        y_flat = ltorch.cat([ltorch.reshape(y, (trash, D)), zero_row], 0)
+        picked = ltorch.take_along_dim(y_flat, idx, 0)  # (N*K, D)
+        out = ltorch.sum(ltorch.reshape(picked * ltorch.unsqueeze(w, 1), (N, K, D)), 1)
         return ltorch.reshape(out, (B, T, D))
+
+
+def publish_moe_stats(model: nn.Module, **attrs) -> int:
+    """Publish every MoEMLP's routing-health buffers (refreshed by the last
+    traced step while observability was enabled) to the ``moe.*`` telemetry
+    registry via ``metrics.record_moe``. Returns the number of MoE layers
+    published. Call once per logged step (bench / quickstart loop)."""
+    from ..observability import events, metrics
+
+    if not events.enabled():
+        return 0
+    n = 0
+    for _, mod in model.named_modules():
+        if isinstance(mod, MoEMLP):
+            bufs = dict(mod.named_buffers())
+            metrics.record_moe(
+                [float(v) for v in bufs["moe_expert_load"]],
+                float(bufs["moe_dropped_tokens"]),
+                float(bufs["moe_router_entropy"]), **attrs)
+            n += 1
+    return n
 
 
 class MoEBlock(nn.Module):

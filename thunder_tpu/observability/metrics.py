@@ -255,6 +255,33 @@ def record_serve(outcome: str, delta: int = 1, event: bool = False, **attrs) -> 
         events.event(f"serve_{outcome}", **attrs)
 
 
+def record_moe(expert_load, dropped_tokens, router_entropy, **attrs) -> None:
+    """Routing health for one MoE step (models/moe.py buffers or the
+    EP stats dict from parallel/expert_parallel.py): counter ``moe.steps``
+    plus ``moe.dropped_tokens`` (cumulative drops — the counter-asserted
+    signal that capacity routing is shedding load), per-expert last-value
+    gauges ``moe.expert_load.e<i>`` with the max under
+    ``moe.expert_load_max`` (1/E = perfectly balanced), and gauge
+    ``moe.router_entropy`` (nats; ln E = uniform router). One ``moe_stats``
+    timeline event carries the full load vector. Zero-work disabled."""
+    if not events.enabled():
+        return
+    from . import telemetry
+
+    load = [float(v) for v in expert_load]
+    dropped = int(dropped_tokens)
+    entropy = float(router_entropy)
+    events.inc("moe.steps")
+    if dropped:
+        events.inc("moe.dropped_tokens", dropped)
+    for i, v in enumerate(load):
+        telemetry.set_gauge(f"moe.expert_load.e{i}", v)
+    telemetry.set_gauge("moe.expert_load_max", max(load) if load else 0.0)
+    telemetry.set_gauge("moe.router_entropy", entropy)
+    events.event("moe_stats", expert_load=load, dropped_tokens=dropped,
+                 router_entropy=entropy, **attrs)
+
+
 def record_fusion(executor: str, n_regions: int, n_ops: int, **attrs) -> None:
     """Fusion-pass outcome for one executor over one trace."""
     if not events.enabled():

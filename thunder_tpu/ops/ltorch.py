@@ -993,6 +993,43 @@ def paged_chunk_attention(q, k_pages, v_pages, page_table, q_pos, scale=None):
     return prims.matmul(probs, v)  # (B, H, T, D)
 
 
+@torchsymbol(name="grouped_mlp", id="thunder.grouped_mlp")
+def grouped_mlp(bins, w_gate, w_up, w_down, group_sizes):
+    """Grouped/ragged SwiGLU expert MLP over capacity-packed token bins
+    (Switch-Transformer/Mixtral-style capacity routing).
+
+    bins         (E, cap, D) — per-expert token bins; rows at index >=
+                 group_sizes[e] are padding and MUST be zero-filled (the
+                 dispatch scatter guarantees this), so SwiGLU maps them to
+                 exactly zero on every road
+    w_gate/w_up  (E, D, H)   — per-expert gate/up projections
+    w_down       (E, H, D)   — per-expert down projection
+    group_sizes  (E,) int    — valid rows per bin; the grouped kernel skips
+                 MXU work for wholly-padding bin blocks, the decomposition
+                 ignores it (zero rows already produce zero outputs)
+
+    The decomposition below is the pure-jax batched-matmul reference path
+    (CPU / interpret mode / unclaimed shapes); the pallas executor claims
+    the symbol whole on TPU with a (expert, bin-block) grid kernel whose
+    MXU matmuls touch only each expert's own bin
+    (executors/pallasex.py:grouped_mlp_fused)."""
+    check(bins.ndim == 3, lambda: f"grouped_mlp: bins must be (E, cap, D), got {bins.shape}")
+    E, cap, D = bins.shape
+    check(tuple(w_gate.shape) == (E, D, w_gate.shape[-1]),
+          lambda: f"grouped_mlp: w_gate {w_gate.shape} must be (E={E}, D={D}, H)")
+    H = w_gate.shape[-1]
+    check(tuple(w_up.shape) == (E, D, H),
+          lambda: f"grouped_mlp: w_up {w_up.shape} must be ({E}, {D}, {H})")
+    check(tuple(w_down.shape) == (E, H, D),
+          lambda: f"grouped_mlp: w_down {w_down.shape} must be ({E}, {H}, {D})")
+    check(tuple(group_sizes.shape) == (E,),
+          lambda: f"grouped_mlp: group_sizes {group_sizes.shape} must be (E={E},)")
+    g = prims.matmul(bins, w_gate)   # (E, cap, H)
+    u = prims.matmul(bins, w_up)
+    h = silu(g) * u
+    return prims.matmul(h, w_down)   # (E, cap, D)
+
+
 @torchsymbol(name="cross_entropy", id="torch.nn.functional.cross_entropy")
 def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
     """Composite cross-entropy over class dim 1 / last for 2D (logits (N, C)).

@@ -36,13 +36,28 @@ def _ring_attention_meta(q, k, v, *, axis, causal=True, scale=None, world_size=1
 
 
 def _ring_attention_impl(q, k, v, *, axis, causal=True, scale=None, world_size=1):
-    """Blockwise ring attention with online softmax. q,k,v: (B, H, T_loc, D)."""
+    """Blockwise ring attention with online softmax. q: (B, H, T_loc, D),
+    k/v: (B, Hkv, T_loc, D) — GQA-native, KV heads are indexed (grouped
+    einsum / kernel head map), never replicated.
+
+    Dispatch: the streaming Pallas ring-flash kernel claims when its VMEM
+    estimate (analysis/memory.py ring_flash_vmem_bytes) fits the budget —
+    the working set stays O(block), not O(T). Otherwise this pure-jax
+    reference ring runs (CPU, interpret, or over-budget shapes)."""
+    from ..executors import pallasex
+
+    if pallasex.ring_flash_supported(q, k, v):
+        return pallasex.ring_flash_attention(
+            q, k, v, axis_name=axis, causal=causal, scale=scale)
+
     B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    g = H // Hkv  # query heads per KV head
     n = world_size
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     my = lax.axis_index(axis)
 
-    qf = q.astype(jnp.float32)
+    qf = q.astype(jnp.float32).reshape(B, Hkv, g, T, D)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     q_pos = my * T + jnp.arange(T)  # global positions of local queries
@@ -50,11 +65,11 @@ def _ring_attention_impl(q, k, v, *, axis, causal=True, scale=None, world_size=1
     def step(carry, i):
         o, m, l, k_blk, v_blk = carry
         src = (my - i) % n  # which device's block we currently hold
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        s = jnp.einsum("bhgqd,bhkd->bhgqk", qf, k_blk.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * T + jnp.arange(T)
             mask = k_pos[None, :] <= q_pos[:, None]  # (Tq, Tk) global causal
-            s = jnp.where(mask[None, None], s, -jnp.inf)
+            s = jnp.where(mask[None, None, None], s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # exp(-inf - -inf) guard: rows with no valid keys keep m=-inf
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -62,19 +77,19 @@ def _ring_attention_impl(q, k, v, *, axis, causal=True, scale=None, world_size=1
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        o = o * corr[..., None] + jnp.einsum("bhgqk,bhkd->bhgqd", p, v_blk.astype(jnp.float32))
         m = m_new
         # rotate K/V around the ring for the next step
         k_blk = lax.ppermute(k_blk, axis, perm)
         v_blk = lax.ppermute(v_blk, axis, perm)
         return (o, m, l, k_blk, v_blk), None
 
-    o0 = jnp.zeros((B, H, T, D), jnp.float32)
-    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, Hkv, g, T, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, g, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, g, T), jnp.float32)
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l[..., None]).astype(q.dtype)
+    return (o / l[..., None]).reshape(B, H, T, D).astype(q.dtype)
 
 
 ring_attention = Symbol(
@@ -130,13 +145,9 @@ class ContextParallelTransform(Transform):
 
         def repl(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False):
             assert attn_mask is None, "context parallel sdpa does not support explicit masks yet"
-            if q.ndim == 4 and k.ndim == 4 and q.shape[1] != k.shape[1]:
-                # replicate GQA/MQA kv heads before the ring: ring_attention's
-                # einsum needs matching head counts (no broadcast)
-                from ..ops import ltorch as _lt
-
-                k = _lt.repeat_interleave(k, q.shape[1] // k.shape[1], 1)
-                v = _lt.repeat_interleave(v, q.shape[1] // v.shape[1], 1)
+            # GQA/MQA kv heads ride through as-is: ring_attention is
+            # GQA-native (grouped einsum / kernel head indexing), so no
+            # O(H/Hkv) KV replication enters the ring
             return ring_attention(q, k, v, axis=axis, causal=is_causal, scale=scale, world_size=n)
 
         new_trc = substitute_symbols(

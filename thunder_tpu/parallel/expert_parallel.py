@@ -68,17 +68,31 @@ def _swiglu_experts(bins, w_gate, w_up, w_down):
 
 
 def moe_ep_forward(params: dict, x, *, mesh, axis: str = "ep",
-                   n_expert_per_token: int = 2, capacity_factor: float | None = None):
+                   dp_axis: str | None = None, n_expert_per_token: int = 2,
+                   capacity_factor: float | None = None,
+                   return_stats: bool = False):
     """Run a Mixtral-style MoE layer with experts AND tokens sharded over
     ``axis``. params: gate_w (D, E) replicated; w_gate/w_up/w_down stacked
     (E, D, H) / (E, D, H) / (E, H, D), sharded on dim 0. x: (N, D) sharded
-    on dim 0. Returns (N, D) sharded on dim 0."""
+    on dim 0. Returns (N, D) sharded on dim 0.
+
+    EP×DP on one mesh: pass ``dp_axis`` to also batch-shard tokens over a
+    data-parallel axis. Tokens live on (dp, ep) jointly; expert weights stay
+    sharded over ``axis`` only (replicated across ``dp_axis``), so each DP
+    slice runs its own all_to_all expert exchange over ICI while gradients
+    for the replicated weights reduce over ``dp_axis`` as usual.
+
+    With ``return_stats`` the routing-health gauges ride along: a dict of
+    ``expert_load`` (E,), ``dropped_tokens`` and ``router_entropy`` — psum'd
+    over the token axes so every host sees fleet totals (feeds the ``moe.*``
+    telemetry registry)."""
     n_dev = mesh.shape[axis]
+    dp_dev = mesh.shape[dp_axis] if dp_axis is not None else 1
     E = params["w_gate"].shape[0]
     assert E % n_dev == 0, f"experts {E} must divide over {axis}={n_dev}"
     K = n_expert_per_token
     N = x.shape[0]
-    n_loc = N // n_dev
+    n_loc = N // (n_dev * dp_dev)
     # capacity: every local (token, k) assignment fits even if all pick the
     # same expert -> the distributed result is drop-free and matches the
     # single-device run exactly (capacity_factor overrides for drop tests)
@@ -108,9 +122,27 @@ def moe_ep_forward(params: dict, x, *, mesh, axis: str = "ep",
         picked = expert_out[flat_e, slot]                     # (n_loc*K, D)
         w = (topk_probs.reshape(-1) * keep.astype(x_loc.dtype))[:, None]
         out = jnp.zeros_like(x_loc).at[tok].add(picked * w)
-        return out
+        if not return_stats:
+            return out
+        # routing health, reduced to fleet totals over every token axis
+        load = jnp.sum(jax.nn.one_hot(flat_e, E, dtype=jnp.float32), 0)
+        dropped = jnp.sum(1.0 - keep.astype(jnp.float32))
+        ent = -jnp.sum(probs * jnp.log(jnp.clip(probs, 1e-30)))
+        for ax in token_axes:
+            load = lax.psum(load, ax)
+            dropped = lax.psum(dropped, ax)
+            ent = lax.psum(ent, ax)
+        stats = {
+            "expert_load": load / jnp.sum(load),
+            "dropped_tokens": dropped,
+            "router_entropy": ent / N,
+        }
+        return out, stats
 
-    specs_in = (P(), P(axis), P(axis), P(axis), P(axis))
-    return shard_map(body, mesh=mesh, in_specs=specs_in, out_specs=P(axis),
+    token_axes = (axis,) if dp_axis is None else (dp_axis, axis)
+    tok_spec = P(token_axes)
+    specs_in = (P(), P(axis), P(axis), P(axis), tok_spec)
+    out_specs = (tok_spec, P()) if return_stats else tok_spec
+    return shard_map(body, mesh=mesh, in_specs=specs_in, out_specs=out_specs,
                      check_rep=False)(
         params["gate_w"], params["w_gate"], params["w_up"], params["w_down"], x)

@@ -305,6 +305,34 @@ def serving_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     return lines
 
 
+def moe_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
+    """MoE routing-health section: moe.* counters (steps, cumulative
+    dropped tokens) plus the latest ``moe_stats`` event's per-expert load
+    vector and router entropy (observability/metrics.py record_moe)."""
+    moe_counters = {k: v for k, v in counters.items() if k.startswith("moe.")}
+    stats = [r for r in recs
+             if r.get("kind") == "event" and r.get("name") == "moe_stats"]
+    if not moe_counters and not stats:
+        return []
+    lines = []
+    for k, v in sorted(moe_counters.items()):
+        lines.append(f"  {k.removeprefix('moe.'):<24} {v}")
+    if stats:
+        a = stats[-1].get("attrs") or {}
+        load = a.get("expert_load") or []
+        if load:
+            peak = max(load)
+            lines.append(f"  expert_load              "
+                         f"[{' '.join(f'{v:.3f}' for v in load)}]  "
+                         f"(max={peak:.3f}, balanced={1 / len(load):.3f})")
+        if a.get("router_entropy") is not None:
+            lines.append(f"  router_entropy           "
+                         f"{a['router_entropy']:.3f} nats")
+        if a.get("dropped_tokens") is not None:
+            lines.append(f"  dropped_tokens (last)    {a['dropped_tokens']}")
+    return lines
+
+
 def checkpoint_lines(recs: list[dict], counters: dict[str, int]) -> list[str]:
     """Checkpoint/robustness section: save/restore traffic, per-host shard
     counts+bytes (distributed sharded saves), save latency, and the
@@ -659,6 +687,9 @@ def render(recs: list[dict], top: int = 0) -> str:
     slo = slo_lines(recs, counters)
     if slo:
         out += ["", "== slo ==", *slo]
+    moe = moe_lines(recs, counters)
+    if moe:
+        out += ["", "== moe ==", *moe]
     ckpt = checkpoint_lines(recs, counters)
     if ckpt:
         out += ["", "== checkpoint / robustness ==", *ckpt]
@@ -674,7 +705,7 @@ def render(recs: list[dict], top: int = 0) -> str:
              and not k.startswith("compile.") and not k.startswith("checkpoint.")
              and not k.startswith("desync.") and not k.startswith("guard.dist_")
              and not k.startswith("fleet.") and not k.startswith("trace.")
-             and not k.startswith("mem.")
+             and not k.startswith("mem.") and not k.startswith("moe.")
              and k.partition(".")[2] not in ("hit", "miss", "evict")}
     if other:
         out += ["", "== counters =="]

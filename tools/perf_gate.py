@@ -61,7 +61,12 @@ HIGHER_BETTER = ("value", "goodput", "requests_per_s", "requests_per_s_slo_met",
                  # the fraction of collective/transfer device time hidden
                  # behind compute — ROADMAP #5a pushes this UP; a scheduler
                  # or partitioner change that serializes comms must fail CI
-                 "overlap_frac")
+                 "overlap_frac",
+                 # grouped-dispatch speedup over the one-hot einsum road on
+                 # the SAME weights (BENCH_MOE.json): the packed E*cap-row
+                 # algorithm decaying back toward the E*N one-hot cost means
+                 # the grouped road (or its kernel claim) silently disengaged
+                 "grouped_vs_onehot", "onehot_tokens_per_sec")
 LOWER_BETTER_PREFIXES = ("ttft_ms", "tbot_ms")
 LOWER_BETTER = ("compile_time_s", "compile_time_warm_s", "host_overhead_us",
                 "ms_per_token", "mem_peak_estimated",
